@@ -1,0 +1,92 @@
+"""Communicator factory.
+
+Reference: ``create_communicator`` in
+REF:chainermn/communicators/__init__.py — a string → class dispatch that is
+the single user entry point for distributed setup, defaulting ``mpi_comm``
+to ``MPI.COMM_WORLD``.  Here the "world" default is the full device mesh
+built from ``jax.devices()`` (``mesh_utils.build_mesh``).
+
+Name map (reference → this package):
+
+=================  ==========================================================
+``naive``          per-parameter psum, CPU-friendly correctness oracle
+``flat``           single fused psum over one packed buffer (alias)
+``pure_nccl``      alias of ``xla_ici`` — the fastest flat backend
+``xla_ici``        the TPU-native headline backend (BASELINE.json)
+``hierarchical``   psum over ``intra`` (ICI) then ``inter`` (DCN)
+``two_dimensional``  reduce-scatter/allreduce/all-gather over ICI×DCN
+``single_host``    ICI-only; asserts one host (ref: ``single_node``)
+``non_cuda_aware``  alias of ``hierarchical`` — the reference's host-staged
+                   fallback has no TPU meaning (XLA owns staging), but the
+                   name resolves for API parity
+=================  ==========================================================
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from jax.sharding import Mesh
+
+from .base import CommunicatorBase
+from .hierarchical import HierarchicalCommunicator
+from .naive import NaiveCommunicator
+from .single_host import SingleHostCommunicator, SingleNodeCommunicator
+from .two_dimensional import TwoDimensionalCommunicator
+from .xla_ici import FlatCommunicator, XlaIciCommunicator
+from . import mesh_utils
+from .mesh_utils import build_mesh
+
+_COMMUNICATORS: dict[str, type[CommunicatorBase]] = {
+    "naive": NaiveCommunicator,
+    "flat": FlatCommunicator,
+    "xla_ici": XlaIciCommunicator,
+    "pure_nccl": XlaIciCommunicator,
+    "hierarchical": HierarchicalCommunicator,
+    "non_cuda_aware": HierarchicalCommunicator,
+    "two_dimensional": TwoDimensionalCommunicator,
+    "single_host": SingleHostCommunicator,
+    "single_node": SingleNodeCommunicator,
+}
+
+
+def create_communicator(
+    communicator_name: str = "xla_ici",
+    mesh: Mesh | None = None,
+    allreduce_grad_dtype: Any | None = None,
+    inter_size: int | None = None,
+    intra_size: int | None = None,
+) -> CommunicatorBase:
+    """Create a communicator by name (reference signature:
+    ``create_communicator(communicator_name='hierarchical', mpi_comm=None,
+    allreduce_grad_dtype=None)``).
+
+    ``mesh`` defaults to the full-slice ``(inter, intra)`` mesh;
+    ``inter_size``/``intra_size`` force a factorization (testing analogue of
+    running ``mpiexec -n 2`` on one box, SURVEY §4).
+    """
+    try:
+        cls = _COMMUNICATORS[communicator_name]
+    except KeyError:
+        raise ValueError(
+            f"unknown communicator {communicator_name!r}; "
+            f"choose from {sorted(_COMMUNICATORS)}"
+        ) from None
+    if mesh is None:
+        mesh = build_mesh(inter_size=inter_size, intra_size=intra_size)
+    return cls(mesh, allreduce_grad_dtype=allreduce_grad_dtype)
+
+
+__all__ = [
+    "CommunicatorBase",
+    "NaiveCommunicator",
+    "FlatCommunicator",
+    "XlaIciCommunicator",
+    "HierarchicalCommunicator",
+    "TwoDimensionalCommunicator",
+    "SingleHostCommunicator",
+    "SingleNodeCommunicator",
+    "create_communicator",
+    "build_mesh",
+    "mesh_utils",
+]

@@ -1,0 +1,27 @@
+"""Naive communicator — the correctness oracle.
+
+Reference: REF:chainermn/communicators/naive_communicator.py, which issues
+one host-memory ``MPI_Allreduce`` per parameter.  The TPU-native analogue
+reduces each gradient leaf with its own ``lax.psum`` (no packing, no dtype
+tricks) so XLA sees one collective per parameter — the simplest possible
+lowering, and the backend every other variant must numerically match
+(SURVEY §4: "NaiveCommunicator ... serves as the correctness oracle").
+
+Runs anywhere, including the forced-host-platform CPU mesh the test suite
+uses in place of the reference's ``mpiexec -n 2`` CI trick.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from .base import CommunicatorBase
+
+
+class NaiveCommunicator(CommunicatorBase):
+    name = "naive"
+
+    def _allreduce_impl(self, tree):
+        n = self.device_size
+        return jax.tree.map(lambda g: lax.psum(g, self.axes) / n, tree)

@@ -1,0 +1,41 @@
+"""Single-host communicator — ICI-only collectives.
+
+Reference: REF:chainermn/communicators/single_node_communicator.py, which
+asserts ``size == intra_size`` and runs NCCL-only allreduce within the node.
+The TPU analogue restricts collectives to the ``intra`` (ICI) axis and
+refuses to construct over a multi-host mesh, so a user gets a loud error
+instead of silent DCN traffic — the same contract as the reference's
+assertion.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from . import mesh_utils
+from .base import CommunicatorBase
+
+
+class SingleHostCommunicator(CommunicatorBase):
+    name = "single_host"
+
+    def __init__(self, mesh=None, axes=None, allreduce_grad_dtype=None):
+        super().__init__(mesh, axes, allreduce_grad_dtype)
+        if self.inter_size != 1 and mesh_utils.AXIS_INTER in self.axes:
+            raise ValueError(
+                "single_host communicator requires inter_size == 1 "
+                f"(got {self.inter_size}); use 'hierarchical'/'xla_ici' "
+                "for multi-host meshes"
+            )
+
+    def _allreduce_impl(self, tree):
+        n = self.device_size
+        return jax.tree.map(
+            lambda g: lax.psum(g, self.axes) / n, tree
+        )
+
+
+# Reference alias: 'single_node'.
+class SingleNodeCommunicator(SingleHostCommunicator):
+    name = "single_node"

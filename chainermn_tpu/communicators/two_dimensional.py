@@ -1,0 +1,65 @@
+"""Two-dimensional communicator — reduce-scatter / inter-allreduce /
+all-gather.
+
+Reference: REF:chainermn/communicators/two_dimensional_communicator.py —
+(1) intra-node NCCL ``reduceScatter`` so each GPU owns 1/intra_size of the
+gradient, (2) inter-node ``MPI_Allreduce`` on each shard (every GPU's NIC
+share in play, unlike hierarchical), (3) intra-node NCCL ``allGather``.
+This is the "hierarchical 2D allreduce" named in BASELINE.json's
+Transformer-WMT config.
+
+TPU-native translation, leaf-fused for one collective group per step: pack
+the gradient pytree into one flat buffer (same packing as the flat/xla_ici
+backend), pad to a multiple of ``intra_size``, then
+``lax.psum_scatter`` over ``intra`` (ICI) → ``lax.psum`` over ``inter``
+(DCN) on the 1/intra_size shard → ``lax.all_gather`` over ``intra``.
+The DCN leg moves only ``1/intra_size`` of the bytes per chip — exactly the
+bandwidth argument the reference's 2-D scheme made for IB, transplanted to
+the ICI/DCN hierarchy.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import mesh_utils
+from .base import CommunicatorBase
+from .xla_ici import pack
+
+
+class TwoDimensionalCommunicator(CommunicatorBase):
+    name = "two_dimensional"
+
+    def __init__(self, mesh=None, axes=None, allreduce_grad_dtype=None):
+        super().__init__(mesh, axes, allreduce_grad_dtype)
+        if mesh_utils.AXIS_INTRA not in self.axes or mesh_utils.AXIS_INTER not in self.axes:
+            raise ValueError(
+                "two_dimensional communicator needs both 'inter' and 'intra' "
+                f"mesh axes; got {self.axes}"
+            )
+
+    def _allreduce_impl(self, tree):
+        leaves = jax.tree.leaves(tree)
+        if not leaves:
+            return tree
+        common = jnp.result_type(*[l.dtype for l in leaves])
+        casted = jax.tree.map(lambda x: x.astype(common), tree)
+        flat, unpack = pack(casted)
+
+        k = self.intra_size
+        n = flat.size
+        pad = (-n) % k
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+
+        shard = lax.psum_scatter(
+            flat, mesh_utils.AXIS_INTRA, scatter_dimension=0, tiled=True
+        )
+        shard = lax.psum(shard, mesh_utils.AXIS_INTER)
+        full = lax.all_gather(shard, mesh_utils.AXIS_INTRA, axis=0, tiled=True)
+
+        full = full[:n] / self.device_size
+        out = unpack(full)
+        return jax.tree.map(lambda x, ref: x.astype(ref.dtype), out, tree)

@@ -1,0 +1,80 @@
+"""Flat single-collective communicator — the ``pure_nccl``/``flat`` analogue.
+
+Reference lineage:
+
+* REF:chainermn/communicators/flat_communicator.py — pack every gradient
+  into ONE contiguous GPU buffer, one ``MPI_Allreduce`` over it, unpack.
+* REF:chainermn/communicators/pure_nccl_communicator.py — same flat buffer
+  but a single ``ncclAllReduce`` across all ranks on a dedicated stream,
+  with an optional fp16 cast-pack (``allreduce_grad_dtype``).
+
+TPU-native translation: flatten + concatenate the gradient pytree into one
+1-D buffer and issue a single ``lax.psum`` over the whole mesh.  XLA lowers
+this to one fused all-reduce riding ICI (and DCN for the ``inter`` axis hops
+on multi-host meshes) — the same "one big collective amortizes latency"
+strategy that made ``pure_nccl`` the reference's fastest backend, which is
+why BASELINE.json maps it to the ``xla_ici`` name.  The optional
+low-precision leg uses bfloat16 (TPU's native low-precision format) instead
+of the reference's fp16.
+
+There is no explicit stream management: XLA's async collectives already
+overlap the allreduce with surrounding compute where data dependence allows
+(SURVEY §7.6).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .base import CommunicatorBase
+
+
+def pack(tree):
+    """Flatten a pytree into (one 1-D buffer, unpack closure).
+
+    The analogue of ``pack_params`` in
+    REF:chainermn/communicators/_memory_utility.py — except XLA owns the
+    copies, so this is a trace-time concatenation the compiler fuses with
+    the collective rather than a runtime memcpy loop.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    flat = jnp.concatenate([jnp.ravel(l) for l in leaves]) if leaves else jnp.zeros((0,))
+
+    shapes = [l.shape for l in leaves]
+    sizes = [l.size for l in leaves]
+
+    def unpack(buf):
+        out, off = [], 0
+        for shape, size in zip(shapes, sizes):
+            out.append(jnp.reshape(buf[off : off + size], shape))
+            off += size
+        return jax.tree.unflatten(treedef, out)
+
+    return flat, unpack
+
+
+class XlaIciCommunicator(CommunicatorBase):
+    name = "xla_ici"
+
+    def _allreduce_impl(self, tree):
+        leaves = jax.tree.leaves(tree)
+        if not leaves:
+            return tree
+        # Pack in a common dtype (cast already applied by allreduce_grad
+        # when allreduce_grad_dtype is set; otherwise promote to the widest
+        # leaf dtype so the single fused collective is well-typed).
+        common = jnp.result_type(*[l.dtype for l in leaves])
+        casted = jax.tree.map(lambda x: x.astype(common), tree)
+        flat, unpack = pack(casted)
+        flat = lax.psum(flat, self.axes) / self.device_size
+        out = unpack(flat)
+        return jax.tree.map(lambda x, ref: x.astype(ref.dtype), out, tree)
+
+
+# ``flat`` is the CUDA-aware-MPI spelling of the same algorithm in the
+# reference; expose it as an alias class so create_communicator('flat')
+# resolves (SURVEY §2.1).
+class FlatCommunicator(XlaIciCommunicator):
+    name = "flat"

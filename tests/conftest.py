@@ -1,0 +1,46 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+The reference's CI trick (SURVEY §4) is ``mpiexec -n 2 pytest`` on one box —
+real SPMD over shared-memory MPI.  The TPU-native analogue is
+``--xla_force_host_platform_device_count=8`` on the CPU platform: one
+process, eight virtual devices, every collective exercised for real through
+``shard_map``.
+
+Note on this container: its sitecustomize registers the axon TPU PJRT
+plugin and sets ``jax_platforms="axon,cpu"`` via ``jax.config`` at
+interpreter start, which beats any later environment variable.  Overriding
+through ``jax.config.update`` here (before the first backend
+initialization) reliably lands the suite on the virtual CPU mesh.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs[:8]
+
+
+@pytest.fixture(scope="session", params=[(1, 8), (2, 4), (4, 2)])
+def mesh(request, devices8):
+    """Meshes factoring 8 devices into (inter, intra) shapes, exercising the
+    single-host and simulated multi-host topologies."""
+    from chainermn_tpu.communicators import build_mesh
+
+    inter, intra = request.param
+    return build_mesh(inter_size=inter, intra_size=intra, devices=devices8)
