@@ -31,11 +31,7 @@ def __getattr__(name):
         from chainermn_tpu import datasets
 
         return getattr(datasets, name)
-    if name in ("create_multi_node_evaluator",):
-        from chainermn_tpu import extensions
-
-        return getattr(extensions, name)
-    if name in ("create_multi_node_checkpointer",):
+    if name in ("create_multi_node_evaluator", "create_multi_node_checkpointer"):
         from chainermn_tpu import extensions
 
         return getattr(extensions, name)

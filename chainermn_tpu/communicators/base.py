@@ -199,8 +199,13 @@ class CommunicatorBase:
     def scatter(self, x, root: int = 0):
         """Traced scatter: root's value is broadcast, each device slices its
         chunk along axis 0 (reference ``scatter``)."""
-        x = self.bcast(x, root)
         n = self.device_size
+        if x.shape[0] % n:
+            raise ValueError(
+                f"scatter axis 0 ({x.shape[0]}) must be divisible by the "
+                f"device count ({n}); pad the input first"
+            )
+        x = self.bcast(x, root)
         chunk = x.shape[0] // n
         return lax.dynamic_slice_in_dim(x, self.axis_index() * chunk, chunk, axis=0)
 
@@ -281,31 +286,54 @@ class CommunicatorBase:
         """PartitionSpec sharding a leading "rank" axis over the world."""
         return P(self.axes if len(self.axes) > 1 else self.axes[0])
 
+    def _eager_cached(self, key, stacked_tree, make_body):
+        """Build-or-reuse a jitted shard_map for an eager collective.
+
+        Keyed by (op, treedef, leaf shapes/dtypes) so repeated calls — the
+        reference's per-step eager ``comm.allreduce_grad(model)`` pattern —
+        hit the compile cache instead of re-tracing a fresh closure.
+        """
+        leaves, treedef = jax.tree.flatten(stacked_tree)
+        cache_key = (key, treedef, tuple((l.shape, jnp.asarray(l).dtype) for l in leaves))
+        cache = getattr(self, "_eager_cache", None)
+        if cache is None:
+            cache = self._eager_cache = {}
+        fn = cache.get(cache_key)
+        if fn is None:
+            spec = self._world_spec
+            body = make_body()
+            specs = jax.tree.map(lambda _: spec, stacked_tree)
+            fn = cache[cache_key] = self._eager(body, (specs,), specs)
+        return fn(stacked_tree)
+
     def eager_allreduce_grad(self, stacked_tree):
         """Eager allreduce over a pytree whose leaves have a leading
         ``device_size`` axis ("each rank's grads", the reference's eager
         ``comm.allreduce_grad(model)`` call shape). Returns the same shape
         with every slice equal to the mean."""
-        spec = self._world_spec
 
-        def body(tree):
-            tree = jax.tree.map(lambda x: jnp.squeeze(x, 0), tree)
-            out = self.allreduce_grad(tree)
-            return jax.tree.map(lambda x: x[None], out)
+        def make_body():
+            def body(tree):
+                tree = jax.tree.map(lambda x: jnp.squeeze(x, 0), tree)
+                out = self.allreduce_grad(tree)
+                return jax.tree.map(lambda x: x[None], out)
 
-        specs = jax.tree.map(lambda _: spec, stacked_tree)
-        return self._eager(body, (specs,), specs)(stacked_tree)
+            return body
+
+        return self._eager_cached("allreduce_grad", stacked_tree, make_body)
 
     def eager_broadcast_data(self, stacked_tree, root: int = 0):
-        spec = self._world_spec
+        def make_body():
+            def body(tree):
+                tree = jax.tree.map(lambda x: jnp.squeeze(x, 0), tree)
+                out = self.broadcast_data(tree, root)
+                return jax.tree.map(lambda x: x[None], out)
 
-        def body(tree):
-            tree = jax.tree.map(lambda x: jnp.squeeze(x, 0), tree)
-            out = self.broadcast_data(tree, root)
-            return jax.tree.map(lambda x: x[None], out)
+            return body
 
-        specs = jax.tree.map(lambda _: spec, stacked_tree)
-        return self._eager(body, (specs,), specs)(stacked_tree)
+        return self._eager_cached(
+            ("broadcast_data", root), stacked_tree, make_body
+        )
 
     def shard_map(self, fn, in_specs, out_specs, check_vma: bool = False):
         """Run ``fn`` in the per-device SPMD view over this communicator's
@@ -341,10 +369,9 @@ class CommunicatorBase:
         from jax.experimental import multihost_utils
 
         payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-        n = int(multihost_utils.process_allgather(np.int64(payload.size)).max())
-        buf = np.zeros(n, np.uint8)
-        buf[: payload.size] = payload
         sizes = multihost_utils.process_allgather(np.int64(payload.size))
+        buf = np.zeros(int(sizes.max()), np.uint8)
+        buf[: payload.size] = payload
         all_bufs = multihost_utils.process_allgather(buf)
         return [
             pickle.loads(np.asarray(all_bufs[i][: int(sizes[i])]).tobytes())
@@ -369,11 +396,19 @@ class CommunicatorBase:
         objs = self.bcast_obj(objs, root)
         return objs[self.rank]
 
+    _barrier_seq = 0  # class-level: every process advances it identically
+
     def barrier(self):
         if self.size > 1:
             from jax.experimental import multihost_utils
 
-            multihost_utils.sync_global_devices(f"chainermn_tpu_barrier_{id(self)}")
+            # sync_global_devices asserts the name matches across processes;
+            # SPMD processes hit barriers in the same order, so a class-level
+            # sequence number is stable where id(self) would not be.
+            CommunicatorBase._barrier_seq += 1
+            multihost_utils.sync_global_devices(
+                f"chainermn_tpu_barrier_{CommunicatorBase._barrier_seq}"
+            )
 
     # ------------------------------------------------------------------
     def split(self, axes: Sequence[str]) -> "CommunicatorBase":
@@ -384,11 +419,25 @@ class CommunicatorBase:
         DP+PP run builds a mesh with ('data','pp') axes and splits per-axis
         sub-communicators from it, as the reference's seq2seq+DP examples
         split MPI_COMM_WORLD.
+
+        Variants whose collective pattern needs both ``inter`` and ``intra``
+        axes (hierarchical, two_dimensional) degrade to the flat
+        single-collective communicator when split down to one axis — the
+        same thing the reference's sub-communicators do, since a split MPI
+        comm loses the node hierarchy too.
         """
-        return type(self)(
-            self.mesh, axes=tuple(axes),
-            allreduce_grad_dtype=self.allreduce_grad_dtype,
-        )
+        try:
+            return type(self)(
+                self.mesh, axes=tuple(axes),
+                allreduce_grad_dtype=self.allreduce_grad_dtype,
+            )
+        except ValueError:
+            from .xla_ici import XlaIciCommunicator
+
+            return XlaIciCommunicator(
+                self.mesh, axes=tuple(axes),
+                allreduce_grad_dtype=self.allreduce_grad_dtype,
+            )
 
     def __repr__(self):
         return (

@@ -1,0 +1,7 @@
+from chainermn_tpu.datasets.scatter_dataset import (  # noqa: F401
+    scatter_dataset,
+    scatter_index,
+    create_empty_dataset,
+    SubDataset,
+    get_n_iterations_for_one_epoch,
+)

@@ -1,0 +1,128 @@
+"""Dataset scattering across hosts.
+
+Reference: REF:chainermn/datasets/scatter_dataset.py —
+``scatter_dataset(dataset, comm, root=0, shuffle=False, seed=None)``: the
+root rank permutes indices (seeded), slices them into ``comm.size``
+near-equal contiguous chunks, MPI-scatters the chunks (pickled), and each
+rank wraps its slice in a Chainer ``SubDataset``.  Equal-ish per-rank epoch
+lengths keep collectives in lockstep (SURVEY §3.4).
+
+TPU-native translation: the unit of data loading under JAX is the *host*
+(each process feeds its local chips, and per-device sharding happens when
+the global batch array is formed), so the scatter is over
+``comm.size = process_count`` host shards.  Because every process can
+compute the same seeded permutation, no object transport is needed in the
+common case — the "scatter" is a deterministic index computation, with the
+root's permutation broadcast over the object plane only when an explicit
+``indices``/unseeded shuffle makes ranks diverge.  Semantics preserved from
+the reference: seeded global permutation, contiguous ±1-equal chunks,
+``len(shard)`` differing by at most one across ranks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from chainermn_tpu.communicators.base import CommunicatorBase
+
+
+class SubDataset:
+    """A view of ``dataset`` at ``indices`` — the Chainer ``SubDataset``
+    analogue, duck-typed to anything with ``__getitem__``/``__len__``."""
+
+    def __init__(self, dataset, indices: np.ndarray):
+        self._dataset = dataset
+        self._indices = np.asarray(indices)
+
+    def __len__(self):
+        return len(self._indices)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self._dataset[int(j)] for j in self._indices[i]]
+        return self._dataset[int(self._indices[i])]
+
+    @property
+    def indices(self) -> np.ndarray:
+        return self._indices
+
+
+def scatter_index(
+    n_total: int, comm: CommunicatorBase, root: int = 0,
+    shuffle: bool = False, seed: Optional[int] = None,
+) -> np.ndarray:
+    """Compute this process's index shard of ``range(n_total)``.
+
+    The chunking arithmetic mirrors the reference exactly: chunks are
+    contiguous runs of the (permuted) index list, sizes differ by at most
+    one, earlier ranks get the longer chunks.
+    """
+    if shuffle:
+        if seed is None:
+            # Ranks must agree on the permutation; without a seed the root
+            # draws it and broadcasts (the reference's pickled scatter path).
+            order = None
+            if comm.rank == root:
+                order = np.random.permutation(n_total)
+            order = comm.bcast_obj(order, root=root)
+        else:
+            order = np.random.RandomState(seed).permutation(n_total)
+    else:
+        order = np.arange(n_total)
+
+    size = comm.size
+    base, rem = divmod(n_total, size)
+    sizes = [base + (1 if r < rem else 0) for r in range(size)]
+    offsets = np.cumsum([0] + sizes)
+    r = comm.rank
+    return order[offsets[r] : offsets[r + 1]]
+
+
+def scatter_dataset(
+    dataset,
+    comm: CommunicatorBase,
+    root: int = 0,
+    shuffle: bool = False,
+    seed: Optional[int] = None,
+    force_equal_length: bool = True,
+) -> SubDataset:
+    """Shard ``dataset`` across processes (reference signature preserved).
+
+    ``force_equal_length`` pads shorter shards by wrapping around their own
+    indices so every rank sees the same epoch length — the lockstep
+    guarantee the reference achieves with ±1 chunks; exact equality is the
+    stricter contract a collective-per-step TPU loop wants.
+    """
+    idx = scatter_index(len(dataset), comm, root=root, shuffle=shuffle, seed=seed)
+    if force_equal_length and comm.size > 1:
+        max_len = -(-len(dataset) // comm.size)
+        if len(idx) < max_len and len(idx) > 0:
+            pad = idx[: max_len - len(idx)]
+            idx = np.concatenate([idx, pad])
+    return SubDataset(dataset, idx)
+
+
+def create_empty_dataset(dataset):
+    """Reference parity (REF:chainermn/datasets/empty_dataset.py): strip a
+    dataset to its length only — used on non-root ranks that must agree on
+    epoch structure without holding data."""
+    return SubDataset(_Empty(len(dataset)), np.arange(len(dataset)))
+
+
+class _Empty:
+    def __init__(self, n):
+        self._n = n
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        return ()
+
+
+def get_n_iterations_for_one_epoch(dataset, local_batch_size: int) -> int:
+    """Iterations per epoch given a per-host batch size (helper the
+    reference keeps in its examples)."""
+    return -(-len(dataset) // local_batch_size)

@@ -1,0 +1,8 @@
+from chainermn_tpu.extensions.multi_node_evaluator import (  # noqa: F401
+    create_multi_node_evaluator,
+    Evaluator,
+)
+from chainermn_tpu.extensions.checkpoint import (  # noqa: F401
+    create_multi_node_checkpointer,
+    MultiNodeCheckpointer,
+)

@@ -1,0 +1,21 @@
+from chainermn_tpu.models.mlp import MLP  # noqa: F401
+
+
+def __getattr__(name):
+    if name in ("ResNet50", "ResNet18", "ResNet"):
+        from chainermn_tpu.models import resnet
+
+        return getattr(resnet, name)
+    if name in ("Seq2Seq",):
+        from chainermn_tpu.models import seq2seq
+
+        return getattr(seq2seq, name)
+    if name in ("Transformer", "TransformerLM"):
+        from chainermn_tpu.models import transformer
+
+        return getattr(transformer, name)
+    if name in ("ViT",):
+        from chainermn_tpu.models import vit
+
+        return getattr(vit, name)
+    raise AttributeError(name)

@@ -1,0 +1,23 @@
+"""MLP — the model of the reference's MNIST example
+(REF:examples/mnist/train_mnist.py: a 784→1000→1000→10 tanh/relu MLP).
+
+Defined with flax.linen; all chainermn_tpu wrappers are pytree-generic so
+any parameter container works, flax being the idiomatic choice on TPU.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    n_units: int = 1000
+    n_out: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.n_units)(x))
+        x = nn.relu(nn.Dense(self.n_units)(x))
+        return nn.Dense(self.n_out)(x)

@@ -1,0 +1,225 @@
+"""Multi-node optimizer — the data-parallel hot path.
+
+Reference: REF:chainermn/optimizers.py — ``create_multi_node_optimizer(
+actual_optimizer, communicator, double_buffering=False)`` wraps any Chainer
+optimizer; on ``update()`` it (first call) broadcasts model parameters from
+rank 0, then runs local backward, ``communicator.allreduce_grad(model)``,
+and the inner optimizer's update.  ``_DoubleBufferingOptimizer`` overlaps
+this step's allreduce with the next step's compute, applying one-step-stale
+averaged gradients.
+
+TPU-native translation (SURVEY §7 "hard part 2" — the eager-API ↔
+traced-step impedance): the reference's imperative per-step
+``allreduce_grad`` call becomes a collective *traced into* one jitted step
+function.  ``make_train_step`` builds that step: a ``shard_map`` over the
+communicator's mesh computes per-device gradients on the local batch shard,
+runs the communicator's characteristic allreduce, and applies an inner
+`optax` transformation on the (now replicated) mean gradients.  XLA then
+owns the overlap: async collectives hide the allreduce behind surrounding
+compute where data dependence allows, which is what the reference's
+dedicated side stream bought it.
+
+Double buffering keeps its reference *semantics* (apply one-step-stale
+means; the first call only reduces, no update) because the staleness — not
+the stream machinery — is what changes training behavior; the overlap
+itself widens, since with stale application the collective's result is not
+needed until the *next* step and XLA may overlap it across the entire
+step boundary.
+
+The imperative parity surface (``setup``/``update``/``target``) is a thin
+stateful veneer over the functional path for users arriving from the
+reference API.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from chainermn_tpu.communicators.base import CommunicatorBase
+
+
+class MultiNodeOptimizerState(NamedTuple):
+    inner: Any            # the wrapped optax optimizer's state
+    step: jnp.ndarray     # int32 step counter
+    comm_buf: Any         # double buffering: previous step's averaged grads
+                          # (None-like zeros tree when double_buffering=False)
+
+
+class MultiNodeOptimizer:
+    """Wrap an ``optax.GradientTransformation`` with distributed gradient
+    averaging — the reference's ``_MultiNodeOptimizer`` reimagined for
+    traced steps."""
+
+    def __init__(
+        self,
+        actual_optimizer: optax.GradientTransformation,
+        communicator: CommunicatorBase,
+        double_buffering: bool = False,
+    ):
+        self.actual_optimizer = actual_optimizer
+        self.communicator = communicator
+        self.double_buffering = double_buffering
+        # imperative-parity state (setup/update/target)
+        self._params = None
+        self._state = None
+        self._step_fn = None
+
+    # ------------------------------------------------------------------
+    # Functional API
+    # ------------------------------------------------------------------
+    def init(self, params) -> MultiNodeOptimizerState:
+        """Initialize optimizer state.  The analogue of the reference's
+        first-``update`` ``broadcast_data``: parameters are replicated from
+        process 0 so every host starts identical."""
+        params = self.broadcast_params(params)
+        zeros = jax.tree.map(jnp.zeros_like, params) if self.double_buffering else ()
+        return MultiNodeOptimizerState(
+            inner=self.actual_optimizer.init(params),
+            step=jnp.zeros((), jnp.int32),
+            comm_buf=zeros,
+        )
+
+    def broadcast_params(self, params):
+        """Host-plane replication from process 0 (reference
+        ``broadcast_data``).  A no-op on one host: device-plane replication
+        is the sharding's job under jit."""
+        if self.communicator.size > 1:
+            from jax.experimental import multihost_utils
+
+            params = multihost_utils.broadcast_one_to_all(params)
+        return params
+
+    def make_train_step(
+        self,
+        loss_fn: Callable,
+        batch_spec=None,
+        donate: bool = True,
+        has_aux: bool = False,
+    ):
+        """Build the jitted SPMD training step.
+
+        ``loss_fn(params, batch) -> loss`` (or ``(loss, aux)`` with
+        ``has_aux``) computes the *local* mean loss on one device's batch
+        shard; the step averages gradients with the communicator's
+        characteristic collective pattern and applies the inner optimizer.
+
+        Returns ``step(params, state, batch) -> (params, state, loss[, aux])``.
+        """
+        comm = self.communicator
+        axes = comm.axes
+        if batch_spec is None:
+            batch_spec = P(axes if len(axes) > 1 else axes[0])
+        opt = self.actual_optimizer
+
+        def body(params, state, batch):
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
+            out, grads = grad_fn(params, batch)
+            loss, aux = out if has_aux else (out, None)
+            loss = lax.pmean(loss, axes)
+
+            if self.double_buffering:
+                # Reference _DoubleBufferingOptimizer: allreduce this
+                # step's grads into buffer B, *apply* last step's averaged
+                # buffer A; skip the inner update entirely on step 0.
+                new_mean = comm.allreduce_grad(grads)
+                stale = state.comm_buf
+
+                def do_update(operand):
+                    params, inner, stale = operand
+                    updates, inner = opt.update(stale, inner, params)
+                    return optax.apply_updates(params, updates), inner
+
+                params, inner = lax.cond(
+                    state.step > 0,
+                    do_update,
+                    lambda operand: (operand[0], operand[1]),
+                    (params, state.inner, stale),
+                )
+                new_state = MultiNodeOptimizerState(
+                    inner=inner, step=state.step + 1, comm_buf=new_mean
+                )
+            else:
+                grads = comm.allreduce_grad(grads)
+                updates, inner = opt.update(grads, state.inner, params)
+                params = optax.apply_updates(params, updates)
+                new_state = MultiNodeOptimizerState(
+                    inner=inner, step=state.step + 1, comm_buf=()
+                )
+            if has_aux:
+                return params, new_state, loss, aux
+            return params, new_state, loss
+
+        n_out = 4 if has_aux else 3
+        mapped = comm.shard_map(
+            body,
+            in_specs=(P(), P(), batch_spec),
+            out_specs=(P(),) * n_out,
+        )
+        donate_argnums = (0, 1) if donate else ()
+        jitted = jax.jit(mapped, donate_argnums=donate_argnums)
+        n_dev = comm.device_size
+
+        @functools.wraps(jitted)
+        def step(params, state, batch):
+            for leaf in jax.tree.leaves(batch):
+                if hasattr(leaf, "shape") and leaf.shape and leaf.shape[0] % n_dev:
+                    raise ValueError(
+                        f"global batch axis ({leaf.shape[0]}) must be divisible "
+                        f"by the communicator's device count ({n_dev}); pad or "
+                        f"drop the remainder (see datasets.toy.batch_iterator "
+                        f"drop_last)"
+                    )
+            return jitted(params, state, batch)
+
+        return step
+
+    # ------------------------------------------------------------------
+    # Imperative parity API (reference: optimizer.setup(model) + update())
+    # ------------------------------------------------------------------
+    def setup(self, params, loss_fn: Callable, batch_spec=None):
+        self._params = self.broadcast_params(params)
+        self._state = self.init(self._params)
+        self._step_fn = self.make_train_step(
+            loss_fn, batch_spec=batch_spec, donate=False
+        )
+        return self
+
+    def update(self, batch):
+        """Imperative one-step update, mirroring the reference's
+        ``optimizer.update(loss_func, *args)`` call shape."""
+        if self._step_fn is None:
+            raise RuntimeError("call setup(params, loss_fn) before update()")
+        self._params, self._state, loss = self._step_fn(
+            self._params, self._state, batch
+        )
+        return loss
+
+    @property
+    def target(self):
+        """Current parameters (reference: ``optimizer.target`` is the model)."""
+        return self._params
+
+    @property
+    def t(self):
+        return int(self._state.step) if self._state is not None else 0
+
+
+def create_multi_node_optimizer(
+    actual_optimizer: optax.GradientTransformation,
+    communicator: CommunicatorBase,
+    double_buffering: bool = False,
+) -> MultiNodeOptimizer:
+    """Reference-parity factory (REF:chainermn/optimizers.py)."""
+    return MultiNodeOptimizer(
+        actual_optimizer,
+        communicator,
+        double_buffering=double_buffering,
+    )

@@ -120,7 +120,7 @@ def test_generic_collectives(mesh):
         np.testing.assert_allclose(np.asarray(g[r]).ravel(), np.arange(n))
 
 
-def test_scatter_and_alltoall(mesh):
+def test_scatter(mesh):
     comm = create_communicator("naive", mesh=mesh)
     n = comm.device_size
 
@@ -141,6 +141,62 @@ def test_scatter_and_alltoall(mesh):
     out = np.asarray(f(data))
     for r in range(n):
         np.testing.assert_allclose(out[r].ravel(), [2 * r, 2 * r + 1])
+
+
+def test_scatter_rejects_indivisible(mesh):
+    comm = create_communicator("naive", mesh=mesh)
+
+    def body(xs):
+        return comm.scatter(xs, root=0)[None]
+
+    f = comm.shard_map(body, in_specs=(P(),), out_specs=comm._world_spec)
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(f)(jnp.arange(float(comm.device_size * 2 + 1)))
+
+
+def test_alltoall(mesh):
+    comm = create_communicator("naive", mesh=mesh)
+    n = comm.device_size
+    # Each rank r holds row r of an n×n matrix; after alltoall each rank
+    # holds column r (the transpose semantics of MPI_Alltoall).
+    mat = jnp.arange(float(n * n)).reshape(n, n)
+
+    def body(row):
+        return comm.alltoall(row, split_axis=1, concat_axis=1)
+
+    f = jax.jit(comm.shard_map(body, in_specs=(comm._world_spec,), out_specs=comm._world_spec))
+    out = np.asarray(f(mat))
+    np.testing.assert_allclose(out, np.arange(n * n, dtype=np.float64).reshape(n, n).T)
+
+
+def test_reduce_scatter(mesh):
+    comm = create_communicator("naive", mesh=mesh)
+    n = comm.device_size
+    # Every rank contributes rank-dependent values; each rank ends with its
+    # shard of the sum.
+    data = jnp.tile(jnp.arange(float(n)), (n, 1))  # rank r holds arange(n)
+
+    def body(x):
+        return comm.reduce_scatter(x[0])[None]
+
+    f = jax.jit(comm.shard_map(body, in_specs=(comm._world_spec,), out_specs=comm._world_spec))
+    out = np.asarray(f(data))
+    for r in range(n):
+        np.testing.assert_allclose(out[r].ravel(), [n * r])
+
+
+def test_ppermute_ring(mesh):
+    comm = create_communicator("naive", mesh=mesh)
+    n = comm.device_size
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(x):
+        return comm.ppermute(x[0], perm)[None]
+
+    f = jax.jit(comm.shard_map(body, in_specs=(comm._world_spec,), out_specs=comm._world_spec))
+    out = np.asarray(f(jnp.arange(float(n)))).ravel()
+    # Rank r receives from r-1.
+    np.testing.assert_allclose(out, np.roll(np.arange(n), 1))
 
 
 def test_axis_index_order(mesh):
@@ -170,6 +226,16 @@ def test_split_subcommunicator(devices8):
     out = np.asarray(f(jnp.arange(8.0)))
     np.testing.assert_allclose(out[:4], np.full(4, 0 + 1 + 2 + 3))
     np.testing.assert_allclose(out[4:], np.full(4, 4 + 5 + 6 + 7))
+
+
+def test_split_hierarchical_degrades_to_flat(devices8):
+    from chainermn_tpu.communicators import XlaIciCommunicator
+
+    mesh = build_mesh(inter_size=2, intra_size=4, devices=devices8)
+    comm = create_communicator("hierarchical", mesh=mesh)
+    sub = comm.split(("intra",))
+    assert isinstance(sub, XlaIciCommunicator)
+    assert sub.device_size == 4
 
 
 def test_obj_plane_single_process(mesh):

@@ -1,0 +1,87 @@
+"""scatter_dataset tests mirroring the reference's
+tests/datasets_tests/test_scatter_dataset.py (SURVEY §4): coverage of all
+indices, ±1-equal chunk sizes, shuffle reproducibility with a seed."""
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.communicators import create_communicator
+from chainermn_tpu.datasets import (
+    SubDataset,
+    create_empty_dataset,
+    scatter_dataset,
+    scatter_index,
+)
+
+
+class _FakeComm:
+    """Stub communicator pinning rank/size — the reference's dummy
+    communicator trick for unit-testing wrapper logic without transport."""
+
+    def __init__(self, rank, size):
+        self.rank = rank
+        self.size = size
+
+    def bcast_obj(self, obj, root=0):
+        return obj
+
+
+@pytest.mark.parametrize("n", [10, 16, 17, 101])
+@pytest.mark.parametrize("size", [1, 2, 3, 8])
+def test_partition_covers_all_indices(n, size):
+    chunks = [scatter_index(n, _FakeComm(r, size)) for r in range(size)]
+    allidx = np.concatenate(chunks)
+    assert sorted(allidx.tolist()) == list(range(n))
+    lens = [len(c) for c in chunks]
+    assert max(lens) - min(lens) <= 1
+    assert lens == sorted(lens, reverse=True)  # earlier ranks get longer chunks
+
+
+def test_seeded_shuffle_is_reproducible():
+    a = scatter_index(100, _FakeComm(1, 4), shuffle=True, seed=7)
+    b = scatter_index(100, _FakeComm(1, 4), shuffle=True, seed=7)
+    np.testing.assert_array_equal(a, b)
+    c = scatter_index(100, _FakeComm(1, 4), shuffle=True, seed=8)
+    assert not np.array_equal(a, c)
+
+
+def test_shuffle_partitions_globally():
+    size = 4
+    chunks = [
+        scatter_index(103, _FakeComm(r, size), shuffle=True, seed=3)
+        for r in range(size)
+    ]
+    allidx = np.concatenate(chunks)
+    assert sorted(allidx.tolist()) == list(range(103))
+
+
+def test_force_equal_length_pads_by_wrapping():
+    data = list(range(10))
+    shards = [
+        scatter_dataset(data, _FakeComm(r, 4), force_equal_length=True)
+        for r in range(4)
+    ]
+    assert all(len(s) == 3 for s in shards)
+    seen = set()
+    for s in shards:
+        seen.update(s.indices.tolist())
+    assert seen == set(range(10))
+
+
+def test_subdataset_getitem():
+    ds = SubDataset([10, 11, 12, 13], np.array([2, 0]))
+    assert ds[0] == 12 and ds[1] == 10
+    assert len(ds) == 2
+    assert ds[0:2] == [12, 10]
+
+
+def test_real_communicator_single_process(mesh):
+    comm = create_communicator("naive", mesh=mesh)
+    shard = scatter_dataset(list(range(50)), comm, shuffle=True, seed=0)
+    assert len(shard) == 50  # single process holds everything
+
+
+def test_create_empty_dataset():
+    ds = create_empty_dataset(list(range(7)))
+    assert len(ds) == 7
+    assert ds[3] == ()
