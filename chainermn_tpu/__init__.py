@@ -40,9 +40,9 @@ def __getattr__(name):
 
         return getattr(links, name)
     if name in ("functions",):
-        from chainermn_tpu import functions
+        import importlib
 
-        return functions
+        return importlib.import_module("chainermn_tpu.functions")
     if name in (
         "create_multi_node_iterator",
         "create_synchronized_iterator",

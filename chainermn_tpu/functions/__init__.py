@@ -1,0 +1,23 @@
+"""Model-parallel autodiff API — facade mirroring REF:chainermn/functions/.
+
+``send``/``recv``/``pseudo_connect`` (point-to-point) and the
+differentiable collectives (``allgather``/``alltoall``/``bcast``/
+``gather``/``scatter``) as autodiff-transparent operations usable inside a
+traced SPMD program.
+"""
+
+from chainermn_tpu.functions.point_to_point import (  # noqa: F401
+    DelegateVariable,
+    send,
+    recv,
+    send_recv,
+)
+from chainermn_tpu.functions.pseudo_connect import pseudo_connect  # noqa: F401
+from chainermn_tpu.functions.collectives import (  # noqa: F401
+    allgather,
+    alltoall,
+    bcast,
+    gather,
+    scatter,
+    allreduce,
+)

@@ -1,0 +1,61 @@
+"""Differentiable collective communication.
+
+Reference: REF:chainermn/functions/collective_communication.py —
+``AllGather``/``AllToAll``/``Bcast``/``Gather``/``Scatter`` as Chainer
+``Function`` classes whose ``backward`` issues the transpose collective
+(e.g. allgather's backward reduce-scatters the incoming gradients).  These
+enable channel/tensor-style parallelism: the parallel_convolution example
+allgathers activations computed per-rank over a channel shard.
+
+TPU-native translation: XLA's collectives are linear operators and JAX
+differentiates them natively with exactly the transposes the reference
+hand-wrote (``all_gather``ᵀ = ``psum_scatter``, ``psum``ᵀ = broadcast,
+``all_to_all``ᵀ = ``all_to_all`` reversed, ``ppermute``ᵀ = inverse
+ppermute).  So the "Function classes" dissolve into thin wrappers over the
+communicator's traced collectives — kept as module-level functions for
+reference API parity and a place to document the autodiff contract.
+All must be called inside ``shard_map`` over the communicator's axes.
+"""
+
+from __future__ import annotations
+
+from chainermn_tpu.communicators.base import CommunicatorBase
+
+
+def allgather(communicator: CommunicatorBase, x, axis: int = 0, tiled: bool = False):
+    """Differentiable allgather (reference ``chainermn.functions.allgather``).
+
+    Forward: every rank receives the concatenation over the world axis.
+    Backward (native): reduce-scatter of the cotangent — each rank gets the
+    sum of all ranks' gradients for its own contribution.
+    """
+    return communicator.allgather(x, axis=axis, tiled=tiled)
+
+
+def alltoall(communicator: CommunicatorBase, x, split_axis: int = 0, concat_axis: int = 0):
+    """Differentiable all-to-all (reference ``chainermn.functions.alltoall``).
+    Backward is the reverse all-to-all."""
+    return communicator.alltoall(x, split_axis=split_axis, concat_axis=concat_axis)
+
+
+def bcast(communicator: CommunicatorBase, x, root: int = 0):
+    """Differentiable broadcast. Backward sums cotangents to the root (the
+    psum in the masked formulation is its own transpose)."""
+    return communicator.bcast(x, root)
+
+
+def gather(communicator: CommunicatorBase, x, root: int = 0, axis: int = 0):
+    """Differentiable gather (SPMD: materialized on every rank; only root's
+    copy is semantically the reference's output)."""
+    return communicator.gather(x, root=root, axis=axis)
+
+
+def scatter(communicator: CommunicatorBase, x, root: int = 0):
+    """Differentiable scatter. Backward gathers the chunk cotangents back."""
+    return communicator.scatter(x, root=root)
+
+
+def allreduce(communicator: CommunicatorBase, x):
+    """Differentiable allreduce (sum). Backward broadcasts — i.e. psum's
+    transpose — matching the reference's allreduce Function."""
+    return communicator.allreduce(x, "sum")

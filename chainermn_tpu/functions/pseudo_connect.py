@@ -1,0 +1,56 @@
+"""Pseudo-connect — graft a delegate variable into the graph.
+
+Reference: REF:chainermn/functions/pseudo_connect.py — ``PseudoConnect``
+returns its actual variables unchanged in forward, but wires the delegate
+variable into the graph so backward reaches the ``Send`` node even when the
+sent tensor has no local consumer; also merges multiple delegates.
+
+TPU-native translation: attach a zero-valued contribution of the delegate's
+token to the actual variable.  ``token`` is a zero-size slice of the
+in-flight ppermute result, so summing it adds exactly 0.0 to the value while
+creating the data dependence that (a) sequences the transfer before any
+consumer of the actual variable and (b) routes cotangents through the
+ppermute transpose back to the sender — the delegate-variable semantics,
+expressed as dataflow.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.functions.point_to_point import DelegateVariable
+
+
+def _token_zero(delegate: DelegateVariable):
+    toks = jax.tree.leaves(delegate.token)
+    z = jnp.zeros((), toks[0].dtype if toks else jnp.float32)
+    for t in toks:
+        z = z + jnp.sum(t)
+    return z
+
+
+def pseudo_connect(delegate_variable, *actual_variables):
+    """Reference-parity ``pseudo_connect(delegate, *actuals)``.
+
+    With no actuals: merges nothing and returns the delegate (it is already
+    graph-connected through its token).  With actuals: returns them with the
+    delegate's gradient path attached; multiple delegates may be chained by
+    passing another delegate as an "actual".
+    """
+    if not actual_variables:
+        return delegate_variable
+
+    z = _token_zero(delegate_variable)
+
+    def graft(v):
+        if isinstance(v, DelegateVariable):
+            # Delegate merging: combine tokens into a fresh delegate.
+            merged = jax.tree.map(
+                lambda t: t + z.astype(t.dtype)[()] * jnp.ones_like(t), v.token
+            )
+            return DelegateVariable(token=merged, payload=v.payload, dst=v.dst)
+        return jax.tree.map(lambda x: x + z.astype(x.dtype), v)
+
+    out = tuple(graft(v) for v in actual_variables)
+    return out[0] if len(out) == 1 else out

@@ -1,0 +1,60 @@
+"""Global exception hook — failure containment.
+
+Reference: REF:chainermn/global_except_hook.py — monkey-patches
+``sys.excepthook`` so an uncaught exception on any rank flushes stderr and
+calls ``MPI_Abort(MPI_COMM_WORLD)``, killing the whole job loudly instead
+of leaving peers deadlocked in a collective (SURVEY §5.3).
+
+TPU-native translation: there is no MPI_Abort; the job-wide kill comes from
+the fact that a vanished process stalls its peers' next DCN collective
+until the coordinator's missed-heartbeat timeout tears the job down.  The
+hook's value is (a) making the *failing* host exit immediately and loudly
+with its process index in the banner (so the culprit is identifiable in a
+pile of timeout logs), and (b) using ``os._exit`` so no atexit/finalizer
+can hang the teardown — the same "die loudly, never deadlock" contract.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+_hook_installed = False
+_EXIT_CODE = 13  # distinct from interpreter default 1: "killed by crash barrier"
+
+
+def _handle_uncaught(exc_type, exc_value, exc_traceback):
+    try:
+        import jax
+
+        rank = jax.process_index()
+        size = jax.process_count()
+    except Exception:
+        rank, size = -1, -1
+    sys.stderr.write(
+        "\n*****************************************************\n"
+        f"chainermn_tpu: uncaught exception on process {rank}/{size};\n"
+        "aborting this host so peers fail fast instead of hanging\n"
+        "in a collective.\n"
+        "*****************************************************\n"
+    )
+    traceback.print_exception(exc_type, exc_value, exc_traceback)
+    sys.stderr.flush()
+    sys.stdout.flush()
+    os._exit(_EXIT_CODE)
+
+
+def add_hook():
+    """Install the crash barrier (reference: ``_add_hook_if_enabled``;
+    idempotent)."""
+    global _hook_installed
+    if not _hook_installed:
+        sys.excepthook = _handle_uncaught
+        _hook_installed = True
+
+
+def remove_hook():
+    global _hook_installed
+    sys.excepthook = sys.__excepthook__
+    _hook_installed = False

@@ -1,0 +1,71 @@
+"""Multi-node iterators.
+
+Reference: REF:chainermn/iterators/ — ``create_multi_node_iterator``
+(rank ``root`` draws batches and broadcasts them, so model-parallel ranks
+see the SAME batch, unlike data-parallel ranks) and
+``create_synchronized_iterator`` (ranks draw independently but stay in
+lockstep on epoch boundaries).
+
+TPU-native shape: these operate on the host/object plane (per process).  On
+a single host they are near-no-ops — all local devices already see the same
+global batch array — but on multi-host model-parallel runs they keep every
+process feeding identical data, which is the invariant the reference's
+iterator wrappers existed to protect.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator
+
+from chainermn_tpu.communicators.base import CommunicatorBase
+
+_STOP = "__chainermn_tpu_stop__"
+
+
+def create_multi_node_iterator(
+    actual_iterator: Iterable, communicator: CommunicatorBase, rank_master: int = 0
+) -> Iterator:
+    """Master draws; everyone receives the same batches (reference-parity).
+
+    The master iterates ``actual_iterator`` and broadcasts each batch over
+    the object plane; non-master ranks ignore their local iterator.  A
+    sentinel broadcast ends every rank's epoch together.
+    """
+
+    def gen():
+        if communicator.rank == rank_master:
+            for batch in actual_iterator:
+                communicator.bcast_obj(batch, root=rank_master)
+                yield batch
+            communicator.bcast_obj(_STOP, root=rank_master)
+        else:
+            while True:
+                batch = communicator.bcast_obj(None, root=rank_master)
+                if isinstance(batch, str) and batch == _STOP:
+                    return
+                yield batch
+
+    return gen()
+
+
+def create_synchronized_iterator(
+    actual_iterator: Iterable, communicator: CommunicatorBase
+) -> Iterator:
+    """Ranks draw from their own iterators but stop together: each step all
+    ranks agree (object-plane allreduce) whether every rank still has data —
+    the lockstep-epoch guarantee (reference-parity)."""
+
+    def gen():
+        it = iter(actual_iterator)
+        while True:
+            try:
+                batch = next(it)
+                have = 1
+            except StopIteration:
+                batch, have = None, 0
+            total = communicator.allreduce_obj(have)
+            if total < communicator.size:
+                return  # someone ran dry: everyone stops this epoch
+            yield batch
+
+    return gen()

@@ -1,0 +1,53 @@
+"""Iterator + except-hook tests (reference: tests/iterators tests and
+global_except_hook behavior)."""
+
+import subprocess
+import sys
+
+import pytest
+
+from chainermn_tpu.communicators import create_communicator
+from chainermn_tpu.iterators import (
+    create_multi_node_iterator,
+    create_synchronized_iterator,
+)
+
+
+def test_multi_node_iterator_single_process(mesh):
+    comm = create_communicator("naive", mesh=mesh)
+    batches = [1, 2, 3]
+    it = create_multi_node_iterator(batches, comm)
+    assert list(it) == [1, 2, 3]
+
+
+def test_synchronized_iterator_single_process(mesh):
+    comm = create_communicator("naive", mesh=mesh)
+    it = create_synchronized_iterator([5, 6], comm)
+    assert list(it) == [5, 6]
+
+
+def test_global_except_hook_exits_loudly():
+    """The crash barrier must exit with its distinct code and print the
+    banner (run in a subprocess; the hook calls os._exit)."""
+    code = (
+        "import chainermn_tpu.global_except_hook as h\n"
+        "h.add_hook()\n"
+        "raise RuntimeError('boom')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=120
+    )
+    assert proc.returncode == 13
+    assert "aborting this host" in proc.stderr
+    assert "boom" in proc.stderr
+
+
+def test_global_except_hook_install_remove():
+    import sys as _sys
+
+    import chainermn_tpu.global_except_hook as h
+
+    h.add_hook()
+    assert _sys.excepthook is h._handle_uncaught
+    h.remove_hook()
+    assert _sys.excepthook is _sys.__excepthook__
