@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 ImageNet-shape training throughput,
+images/sec/chip — the metric BASELINE.json tracks.
+
+Runs the FULL data-parallel training step (forward, backward, gradient
+allreduce via the xla_ici communicator, SGD+momentum update, cross-replica
+BatchNorm sync) on whatever devices are visible — the single real TPU chip
+under the driver, a CPU mesh when forced.
+
+``vs_baseline``: the reference stack's public record is ResNet-50/ImageNet
+in 15 min on 1024 P100s (arXiv:1711.04325) → 1.28M images × 90 epochs /
+900 s / 1024 chips ≈ 125 images/sec/chip.  That is the per-chip rate this
+number is measured against (>1.0 = beating the reference's chips).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import chainermn_tpu
+from chainermn_tpu.models.resnet import ResNet50
+
+REFERENCE_IMAGES_PER_SEC_PER_CHIP = 125.0  # P100, ChainerMN pure_nccl era
+
+
+def main():
+    comm = chainermn_tpu.create_communicator("xla_ici")
+    n_dev = comm.device_size
+    per_chip_batch = 64
+    global_batch = per_chip_batch * n_dev
+    image = (224, 224, 3)
+
+    model = ResNet50(num_classes=1000)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, *image), jnp.float32), train=True
+    )
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    opt = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(0.1, momentum=0.9), comm
+    )
+    state = opt.init(params)
+
+    def loss_fn(params, batch_stats, batch):
+        x, y = batch
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            x, train=True, mutable=["batch_stats"],
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        return loss, updates["batch_stats"]
+
+    step = opt.make_train_step_with_state(loss_fn, donate=True)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(global_batch, *image), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 1000, size=global_batch), jnp.int32)
+
+    # Warmup (compile + stabilize).
+    for _ in range(3):
+        params, state, batch_stats, loss = step(params, state, batch_stats, (x, y))
+    jax.block_until_ready(loss)
+
+    n_steps = 10
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        params, state, batch_stats, loss = step(params, state, batch_stats, (x, y))
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    ips = global_batch * n_steps / dt
+    per_chip = ips / n_dev
+    print(
+        json.dumps(
+            {
+                "metric": "images/sec/chip ResNet-50 ImageNet train step",
+                "value": round(per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(per_chip / REFERENCE_IMAGES_PER_SEC_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,7 @@ class _Component(NamedTuple):
     rank: int               # owner flat device rank
     rank_in: Optional[Sequence[int]]
     rank_out: Optional[Sequence[int]]
+    needs_input: bool       # also pass the chain's global input to fn
 
 
 def _as_ranks(r) -> Optional[Sequence[int]]:
@@ -70,6 +71,7 @@ class MultiNodeChainList:
         rank: int,
         rank_in=None,
         rank_out=None,
+        needs_input: bool = False,
     ):
         """Register ``fn(params, x) -> y`` owned by flat device ``rank``.
 
@@ -77,10 +79,14 @@ class MultiNodeChainList:
         the chain's global input).  ``rank_out``: peer rank(s) to send the
         output to (None → this component's output is the chain's output).
         Matches the reference's ``add_link(link, rank_in, rank_out)`` with
-        the owner made explicit.
+        the owner made explicit.  ``needs_input=True`` additionally passes
+        the chain's global input after the received payload(s) — the
+        analogue of a reference component closing over its local batch
+        (e.g. a decoder needing both the encoder state and the target
+        tokens).
         """
         self._components.append(
-            _Component(fn, rank, _as_ranks(rank_in), _as_ranks(rank_out))
+            _Component(fn, rank, _as_ranks(rank_in), _as_ranks(rank_out), needs_input)
         )
         return self
 
@@ -106,7 +112,7 @@ class MultiNodeChainList:
         out = None
 
         for component, params in zip(self._components, params_list):
-            fn, owner, rank_in, rank_out = component
+            fn, owner, rank_in, rank_out, needs_input = component
 
             # 1. Gather inputs (reference: recv for rank_in).
             if rank_in is None:
@@ -125,6 +131,8 @@ class MultiNodeChainList:
                         )
                     delegate = queue.pop(0)
                     payloads.append(p2p.recv(comm, src, delegate_variable=delegate))
+                if needs_input:
+                    payloads.append(x)
                 inp = payloads[0] if len(payloads) == 1 else tuple(payloads)
 
             # 2. Local compute, skipped (runtime branch) on non-owners.

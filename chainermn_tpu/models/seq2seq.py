@@ -1,0 +1,77 @@
+"""Seq2seq encoder/decoder — the reference's model-parallel acceptance test.
+
+Reference: REF:examples/seq2seq/seq2seq.py — an NMT model whose encoder and
+decoder live on different ranks, wired through ``MultiNodeChainList`` with
+``send``/``recv`` (BASELINE config #3).
+
+TPU-first: GRU recurrences via ``flax.linen.RNN`` (lax.scan under jit —
+compiler-friendly sequential control flow), bf16-ready embeddings, and a
+clean encoder/decoder split so the pair drops into ``MultiNodeChainList``
+(encoder rank → decoder rank, hidden state as the transferred payload).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+PAD, BOS, EOS = 0, 1, 2
+
+
+class Encoder(nn.Module):
+    vocab: int
+    d_model: int = 256
+    n_layers: int = 2
+
+    @nn.compact
+    def __call__(self, src):
+        """(B, S) int tokens → (n_layers, B, H) final hidden states."""
+        x = nn.Embed(self.vocab, self.d_model, name="embed")(src)
+        carries = []
+        for i in range(self.n_layers):
+            rnn = nn.RNN(nn.GRUCell(self.d_model), name=f"gru_{i}")
+            x = rnn(x)
+            carries.append(x[:, -1])  # final state per layer
+        return jnp.stack(carries)
+
+
+class Decoder(nn.Module):
+    vocab: int
+    d_model: int = 256
+    n_layers: int = 2
+
+    @nn.compact
+    def __call__(self, hidden, tgt_in):
+        """Teacher-forced decode: ``hidden`` (n_layers, B, H) from the
+        encoder, ``tgt_in`` (B, T) shifted-right targets → (B, T, vocab)."""
+        x = nn.Embed(self.vocab, self.d_model, name="embed")(tgt_in)
+        for i in range(self.n_layers):
+            cell = nn.GRUCell(self.d_model)
+            rnn = nn.RNN(cell, name=f"gru_{i}")
+            x = rnn(x, initial_carry=hidden[i])
+        return nn.Dense(self.vocab, dtype=jnp.float32, name="proj")(x)
+
+
+class Seq2seq(nn.Module):
+    """Single-device composition (the oracle the split model must match)."""
+
+    vocab: int
+    d_model: int = 256
+    n_layers: int = 2
+
+    @nn.compact
+    def __call__(self, src, tgt_in):
+        h = Encoder(self.vocab, self.d_model, self.n_layers, name="encoder")(src)
+        return Decoder(self.vocab, self.d_model, self.n_layers, name="decoder")(
+            h, tgt_in
+        )
+
+
+def shift_right(tgt):
+    """Prepend BOS, drop last — the teacher-forcing input."""
+    return jnp.concatenate(
+        [jnp.full((tgt.shape[0], 1), BOS, tgt.dtype), tgt[:, :-1]], axis=1
+    )

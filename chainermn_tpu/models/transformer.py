@@ -1,0 +1,182 @@
+"""Transformer encoder-decoder and LM.
+
+Reference anchor: BASELINE config #4 ("Transformer enc-dec WMT,
+hierarchical 2D allreduce on multi-host v4 pod") — the reference repo
+itself had no transformer (it predates them); this is the net-new model
+family the baseline configs demand, built TPU-first: bf16 activations,
+einsum attention that XLA tiles onto the MXU, static shapes, and
+``lax.scan``-free dense blocks (depth unrolled at trace time).
+
+Tensor-parallel note: head and MLP-hidden dimensions are the natural
+``model``-axis shardings; ``chainermn_tpu.parallel.sharding`` carries the
+PartitionSpec rules, and the attention layer can run sequence-parallel via
+``chainermn_tpu.parallel.ring_attention`` / ``ulysses``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+def sinusoidal_positions(max_len: int, d_model: int) -> np.ndarray:
+    pos = np.arange(max_len)[:, None]
+    div = np.exp(np.arange(0, d_model, 2) * (-np.log(10000.0) / d_model))
+    pe = np.zeros((max_len, d_model), np.float32)
+    pe[:, 0::2] = np.sin(pos * div)
+    pe[:, 1::2] = np.cos(pos * div)
+    return pe
+
+
+class MultiHeadAttention(nn.Module):
+    d_model: int
+    n_heads: int
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[Callable] = None  # pluggable (ring/ulysses SP)
+
+    @nn.compact
+    def __call__(self, q_in, kv_in, mask=None):
+        d_head = self.d_model // self.n_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (self.n_heads, d_head), dtype=self.dtype, name=name, use_bias=False
+        )
+        q = dense("query")(q_in)
+        k = dense("key")(kv_in)
+        v = dense("value")(kv_in)
+
+        if self.attention_fn is not None:
+            out = self.attention_fn(q, k, v, mask)
+        else:
+            scale = 1.0 / np.sqrt(d_head)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            if mask is not None:
+                logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+            weights = nn.softmax(logits.astype(jnp.float32)).astype(self.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+        return nn.DenseGeneral(
+            self.d_model, axis=(-2, -1), dtype=self.dtype, name="out", use_bias=False
+        )(out)
+
+
+class FeedForward(nn.Module):
+    d_model: int
+    d_ff: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(self.d_ff, dtype=self.dtype, use_bias=False, name="wi")(x)
+        h = nn.gelu(h)
+        return nn.Dense(self.d_model, dtype=self.dtype, use_bias=False, name="wo")(h)
+
+
+class EncoderLayer(nn.Module):
+    d_model: int
+    n_heads: int
+    d_ff: int
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x + MultiHeadAttention(
+            self.d_model, self.n_heads, self.dtype, self.attention_fn
+        )(h, h, mask)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        return x + FeedForward(self.d_model, self.d_ff, self.dtype)(h)
+
+
+class DecoderLayer(nn.Module):
+    d_model: int
+    n_heads: int
+    d_ff: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, y, enc, self_mask=None, cross_mask=None):
+        h = nn.LayerNorm(dtype=self.dtype)(y)
+        y = y + MultiHeadAttention(self.d_model, self.n_heads, self.dtype, name="self_attn")(
+            h, h, self_mask
+        )
+        h = nn.LayerNorm(dtype=self.dtype)(y)
+        y = y + MultiHeadAttention(self.d_model, self.n_heads, self.dtype, name="cross_attn")(
+            h, enc, cross_mask
+        )
+        h = nn.LayerNorm(dtype=self.dtype)(y)
+        return y + FeedForward(self.d_model, self.d_ff, self.dtype)(h)
+
+
+def causal_mask(length: int):
+    return jnp.tril(jnp.ones((1, 1, length, length), bool))
+
+
+class Transformer(nn.Module):
+    """Encoder-decoder transformer (WMT-shape, BASELINE config #4)."""
+
+    vocab: int
+    d_model: int = 512
+    n_heads: int = 8
+    d_ff: int = 2048
+    n_enc_layers: int = 6
+    n_dec_layers: int = 6
+    max_len: int = 512
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, src, tgt):
+        """``src``: (B, S) int tokens; ``tgt``: (B, T) int tokens (shifted
+        right by the caller). Returns (B, T, vocab) fp32 logits."""
+        embed = nn.Embed(self.vocab, self.d_model, dtype=self.dtype, name="embed")
+        pe = jnp.asarray(sinusoidal_positions(self.max_len, self.d_model))
+
+        x = embed(src) + pe[None, : src.shape[1]].astype(self.dtype)
+        src_mask = (src != 0)[:, None, None, :]
+        for i in range(self.n_enc_layers):
+            x = EncoderLayer(
+                self.d_model, self.n_heads, self.d_ff, self.dtype,
+                self.attention_fn, name=f"enc_{i}",
+            )(x, src_mask)
+        x = nn.LayerNorm(dtype=self.dtype, name="enc_norm")(x)
+
+        y = embed(tgt) + pe[None, : tgt.shape[1]].astype(self.dtype)
+        self_mask = causal_mask(tgt.shape[1]) & (tgt != 0)[:, None, None, :]
+        for i in range(self.n_dec_layers):
+            y = DecoderLayer(
+                self.d_model, self.n_heads, self.d_ff, self.dtype, name=f"dec_{i}"
+            )(y, x, self_mask, src_mask)
+        y = nn.LayerNorm(dtype=self.dtype, name="dec_norm")(y)
+        logits = embed.attend(y.astype(jnp.float32))
+        return logits
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM — the long-context workhorse for the
+    sequence-parallel (ring attention / Ulysses) layers."""
+
+    vocab: int
+    d_model: int = 512
+    n_heads: int = 8
+    d_ff: int = 2048
+    n_layers: int = 6
+    max_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        embed = nn.Embed(self.vocab, self.d_model, dtype=self.dtype, name="embed")
+        pe = jnp.asarray(sinusoidal_positions(self.max_len, self.d_model))
+        x = embed(tokens) + pe[None, : tokens.shape[1]].astype(self.dtype)
+        mask = causal_mask(tokens.shape[1])
+        for i in range(self.n_layers):
+            x = EncoderLayer(
+                self.d_model, self.n_heads, self.d_ff, self.dtype,
+                self.attention_fn, name=f"layer_{i}",
+            )(x, mask)
+        x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
+        return embed.attend(x.astype(jnp.float32))

@@ -1,0 +1,60 @@
+"""Vision Transformer — BASELINE config #5 (ViT-B/16 mixed data+pipeline
+parallel with double-buffered allreduce).
+
+Net-new model family (the reference predates ViTs); TPU-first: patchify as
+a single strided conv, bf16 einsum attention on the MXU, fp32 head.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from chainermn_tpu.models.transformer import EncoderLayer
+
+
+class ViT(nn.Module):
+    num_classes: int = 1000
+    patch: int = 16
+    d_model: int = 768
+    n_heads: int = 12
+    d_ff: int = 3072
+    n_layers: int = 12
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        B = x.shape[0]
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.d_model,
+            (self.patch, self.patch),
+            strides=(self.patch, self.patch),
+            dtype=self.dtype,
+            name="patchify",
+        )(x)
+        x = x.reshape(B, -1, self.d_model)
+
+        cls = self.param(
+            "cls", nn.initializers.zeros, (1, 1, self.d_model), jnp.float32
+        )
+        x = jnp.concatenate([jnp.tile(cls.astype(self.dtype), (B, 1, 1)), x], axis=1)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (1, x.shape[1], self.d_model),
+            jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+
+        for i in range(self.n_layers):
+            x = EncoderLayer(
+                self.d_model, self.n_heads, self.d_ff, self.dtype, name=f"block_{i}"
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x[:, 0])
+
+
+ViT_B16 = ViT  # defaults are the B/16 configuration

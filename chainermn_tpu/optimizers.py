@@ -181,6 +181,61 @@ class MultiNodeOptimizer:
 
         return step
 
+    def make_train_step_with_state(
+        self,
+        loss_fn: Callable,
+        batch_spec=None,
+        donate: bool = True,
+    ):
+        """Like :meth:`make_train_step` for models with non-trainable mutable
+        state (BatchNorm statistics etc. — flax's ``batch_stats``).
+
+        ``loss_fn(params, model_state, batch) -> (loss, new_model_state)``.
+        The new model state is ``pmean``-synchronized across the world —
+        cross-replica BatchNorm, a strict improvement over the reference's
+        per-GPU statistics.
+
+        Returns ``step(params, opt_state, model_state, batch) ->
+        (params, opt_state, model_state, loss)``.
+        """
+        if self.double_buffering:
+            raise NotImplementedError(
+                "double_buffering with mutable model state is not supported "
+                "yet; use make_train_step or double_buffering=False"
+            )
+        comm = self.communicator
+        axes = comm.axes
+        if batch_spec is None:
+            batch_spec = P(axes if len(axes) > 1 else axes[0])
+        opt = self.actual_optimizer
+
+        def body(params, state, model_state, batch):
+            (loss, new_model_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, model_state, batch)
+            loss = lax.pmean(loss, axes)
+            new_model_state = jax.tree.map(
+                lambda x: lax.pmean(x, axes)
+                if jnp.issubdtype(x.dtype, jnp.floating)
+                else x,
+                new_model_state,
+            )
+            grads = comm.allreduce_grad(grads)
+            updates, inner = opt.update(grads, state.inner, params)
+            params = optax.apply_updates(params, updates)
+            new_state = MultiNodeOptimizerState(
+                inner=inner, step=state.step + 1, comm_buf=()
+            )
+            return params, new_state, new_model_state, loss
+
+        mapped = comm.shard_map(
+            body,
+            in_specs=(P(), P(), P(), batch_spec),
+            out_specs=(P(),) * 4,
+        )
+        donate_argnums = (0, 1, 2) if donate else ()
+        return jax.jit(mapped, donate_argnums=donate_argnums)
+
     # ------------------------------------------------------------------
     # Imperative parity API (reference: optimizer.setup(model) + update())
     # ------------------------------------------------------------------

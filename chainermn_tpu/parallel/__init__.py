@@ -1,0 +1,21 @@
+"""Parallelism strategies beyond plain data parallelism.
+
+The reference's coverage (SURVEY §2.5): DP is its core product, TP exists
+embryonically (differentiable allgather + the parallel_convolution
+example), PP in primitive form (MultiNodeChainList send/recv), SP/ring
+attention not at all.  This subpackage is where the TPU build both mirrors
+those and supplies the net-new strategies the task requires.
+"""
+
+from chainermn_tpu.parallel.sharding import (  # noqa: F401
+    transformer_param_spec,
+    make_gspmd_train_step,
+)
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in ("ring_attention", "ulysses", "pipeline"):
+        return importlib.import_module(f"chainermn_tpu.parallel.{name}")
+    raise AttributeError(name)
