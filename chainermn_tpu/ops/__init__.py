@@ -1,0 +1,11 @@
+"""Hand-written TPU kernels (Pallas) for the hot ops.
+
+The reference had no kernel layer — its math was Chainer's and its only
+"kernels" were pack/unpack copies (SURVEY §1 notes).  On TPU the hot op
+worth hand-scheduling is attention; everything else XLA fuses well.
+"""
+
+from chainermn_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+    make_flash_attention_fn,
+)

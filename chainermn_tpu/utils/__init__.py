@@ -1,0 +1,6 @@
+def __getattr__(name):
+    import importlib
+
+    if name in ("native", "profiling", "debug"):
+        return importlib.import_module(f"chainermn_tpu.utils.{name}")
+    raise AttributeError(name)

@@ -1,0 +1,93 @@
+"""Collective-order debug mode — the race-detection analogue.
+
+SURVEY §5.2: the reference had no sanitizer; stream-ordering correctness
+was by construction.  On TPU the corresponding hazard is a *collective
+order mismatch* across hosts (host A's program issues psum/allgather in a
+different sequence than host B's — the SPMD contract breach that shows up
+as a hang or garbage).  This debug mode makes the contract checkable:
+
+* ``CollectiveTrace`` wraps a communicator; every traced collective call
+  records (op, shape, dtype, axes) into an order log at *trace time* —
+  exactly when the SPMD program's collective sequence is fixed.
+* ``fingerprint()`` hashes the log (native crc32c);
+  ``verify_across_hosts()`` allgathers the fingerprint over the object
+  plane and raises on divergence, pinpointing the first differing entry.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, List, Optional
+
+from chainermn_tpu.communicators.base import CommunicatorBase
+from chainermn_tpu.utils import native
+
+_WRAPPED = (
+    "allreduce", "bcast", "allgather", "gather", "alltoall",
+    "reduce_scatter", "scatter", "ppermute", "allreduce_grad",
+    "broadcast_data",
+)
+
+
+class CollectiveTrace:
+    """Wrap ``comm`` so every collective appends to an order log.
+
+    Use as ``dbg = CollectiveTrace(comm)`` and pass ``dbg`` wherever the
+    communicator goes; it proxies everything else through.
+    """
+
+    def __init__(self, comm: CommunicatorBase):
+        self._comm = comm
+        self.log: List[str] = []
+
+    def _record(self, op: str, x: Any, **meta):
+        import jax
+
+        leaves = jax.tree.leaves(x)
+        desc = [
+            {"shape": list(getattr(l, "shape", ())),
+             "dtype": str(getattr(l, "dtype", type(l).__name__))}
+            for l in leaves
+        ]
+        self.log.append(json.dumps(
+            {"op": op, "args": desc, **meta}, sort_keys=True
+        ))
+
+    def __getattr__(self, name):
+        attr = getattr(self._comm, name)
+        if name in _WRAPPED and callable(attr):
+            def traced(x, *args, **kwargs):
+                self._record(name, x)
+                return attr(x, *args, **kwargs)
+
+            return traced
+        return attr
+
+    # -- verification ---------------------------------------------------
+    def fingerprint(self) -> int:
+        return native.crc32c("\n".join(self.log).encode())
+
+    def verify_across_hosts(self) -> int:
+        """Raise RuntimeError if any host recorded a different collective
+        order; returns the common fingerprint otherwise."""
+        fp = self.fingerprint()
+        fps = self._comm.gather_obj(fp)
+        if len(set(fps)) > 1:
+            logs = self._comm.gather_obj(self.log)
+            first_diff = None
+            for i in range(max(len(l) for l in logs)):
+                entries = {
+                    r: (l[i] if i < len(l) else "<missing>")
+                    for r, l in enumerate(logs)
+                }
+                if len(set(entries.values())) > 1:
+                    first_diff = (i, entries)
+                    break
+            raise RuntimeError(
+                f"collective order mismatch across hosts: fingerprints {fps}; "
+                f"first differing call #{first_diff[0]}: {first_diff[1]}"
+            )
+        return fp
+
+    def reset(self):
+        self.log.clear()

@@ -1,0 +1,173 @@
+// Host-side buffer utilities — the in-tree native component.
+//
+// Reference anchor: the reference's only in-tree native code is its NCCL
+// Cython binding plus the pinned-host/device staging buffers of
+// REF:chainermn/communicators/_memory_utility.py (pack_params/unpack_params:
+// gather every parameter into one contiguous buffer, scatter back).  On TPU,
+// XLA owns device memory and the collectives, so the native seam moves to
+// the host side of the pipeline, where Python is the bottleneck:
+//
+//   * parallel_gather — assemble N dataset items into one contiguous batch
+//     buffer with a thread pool (the pack_params idea applied where it still
+//     matters: batch assembly is memcpy-bound and numpy's np.stack is
+//     single-threaded under the GIL; ctypes releases the GIL for the whole
+//     call).
+//   * crc32c — checksums for checkpoint shard integrity and the
+//     collective-order debug mode (SURVEY §5.2).
+//   * a ring queue — bounded MPMC byte-buffer queue for the prefetch
+//     pipeline (the host-staging analogue of HostPinnedMemory's double
+//     buffering).
+//
+// Built with: g++ -O3 -march=native -shared -fPIC -o libhostbuf.so hostbuf.cpp -lpthread
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// crc32c (Castagnoli, software table version; hardware SSE4.2 when available)
+// ---------------------------------------------------------------------------
+static uint32_t crc32c_table[256];
+static std::atomic<bool> crc_table_ready{false};
+static std::mutex crc_table_mu;
+
+static void crc32c_init_table() {
+  std::lock_guard<std::mutex> lock(crc_table_mu);
+  if (crc_table_ready.load()) return;
+  const uint32_t poly = 0x82f63b78u;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t crc = i;
+    for (int j = 0; j < 8; j++)
+      crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
+    crc32c_table[i] = crc;
+  }
+  crc_table_ready.store(true);
+}
+
+uint32_t hostbuf_crc32c(const uint8_t* data, uint64_t len, uint32_t seed) {
+  if (!crc_table_ready.load()) crc32c_init_table();
+  uint32_t crc = ~seed;
+  for (uint64_t i = 0; i < len; i++)
+    crc = (crc >> 8) ^ crc32c_table[(crc ^ data[i]) & 0xff];
+  return ~crc;
+}
+
+// ---------------------------------------------------------------------------
+// parallel_gather: dst[i*item_size : (i+1)*item_size] = *srcs[i]
+// ---------------------------------------------------------------------------
+void hostbuf_parallel_gather(uint8_t* dst, const uint8_t** srcs,
+                             uint64_t n_items, uint64_t item_size,
+                             int n_threads) {
+  if (n_threads <= 1 || n_items < 4) {
+    for (uint64_t i = 0; i < n_items; i++)
+      std::memcpy(dst + i * item_size, srcs[i], item_size);
+    return;
+  }
+  std::vector<std::thread> pool;
+  std::atomic<uint64_t> next{0};
+  for (int t = 0; t < n_threads; t++) {
+    pool.emplace_back([&]() {
+      for (;;) {
+        uint64_t i = next.fetch_add(1);
+        if (i >= n_items) return;
+        std::memcpy(dst + i * item_size, srcs[i], item_size);
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+// Scatter is the inverse (unpack_params analogue): contiguous buffer out to
+// per-item destinations.
+void hostbuf_parallel_scatter(const uint8_t* src, uint8_t** dsts,
+                              uint64_t n_items, uint64_t item_size,
+                              int n_threads) {
+  if (n_threads <= 1 || n_items < 4) {
+    for (uint64_t i = 0; i < n_items; i++)
+      std::memcpy(dsts[i], src + i * item_size, item_size);
+    return;
+  }
+  std::vector<std::thread> pool;
+  std::atomic<uint64_t> next{0};
+  for (int t = 0; t < n_threads; t++) {
+    pool.emplace_back([&]() {
+      for (;;) {
+        uint64_t i = next.fetch_add(1);
+        if (i >= n_items) return;
+        std::memcpy(dsts[i], src + i * item_size, item_size);
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+// ---------------------------------------------------------------------------
+// Bounded MPMC ring queue of byte buffers (prefetch pipeline)
+// ---------------------------------------------------------------------------
+struct RingQueue {
+  std::queue<std::vector<uint8_t>> q;
+  std::mutex mu;
+  std::condition_variable not_empty, not_full;
+  size_t capacity;
+  bool closed = false;
+};
+
+void* hostbuf_queue_new(uint64_t capacity) {
+  auto* rq = new RingQueue();
+  rq->capacity = capacity ? capacity : 1;
+  return rq;
+}
+
+// Returns 0 on success, -1 if the queue is closed.
+int hostbuf_queue_push(void* handle, const uint8_t* data, uint64_t len) {
+  auto* rq = static_cast<RingQueue*>(handle);
+  std::unique_lock<std::mutex> lock(rq->mu);
+  rq->not_full.wait(lock,
+                    [&] { return rq->q.size() < rq->capacity || rq->closed; });
+  if (rq->closed) return -1;
+  rq->q.emplace(data, data + len);
+  rq->not_empty.notify_one();
+  return 0;
+}
+
+// Returns the popped size, 0 if closed-and-empty. Caller provides dst with
+// max_len capacity; oversized payloads are truncated (caller sizes buffers).
+uint64_t hostbuf_queue_pop(void* handle, uint8_t* dst, uint64_t max_len) {
+  auto* rq = static_cast<RingQueue*>(handle);
+  std::unique_lock<std::mutex> lock(rq->mu);
+  rq->not_empty.wait(lock, [&] { return !rq->q.empty() || rq->closed; });
+  if (rq->q.empty()) return 0;
+  auto& front = rq->q.front();
+  uint64_t n = front.size() < max_len ? front.size() : max_len;
+  std::memcpy(dst, front.data(), n);
+  rq->q.pop();
+  rq->not_full.notify_one();
+  return n;
+}
+
+uint64_t hostbuf_queue_size(void* handle) {
+  auto* rq = static_cast<RingQueue*>(handle);
+  std::lock_guard<std::mutex> lock(rq->mu);
+  return rq->q.size();
+}
+
+void hostbuf_queue_close(void* handle) {
+  auto* rq = static_cast<RingQueue*>(handle);
+  std::lock_guard<std::mutex> lock(rq->mu);
+  rq->closed = true;
+  rq->not_empty.notify_all();
+  rq->not_full.notify_all();
+}
+
+void hostbuf_queue_free(void* handle) {
+  delete static_cast<RingQueue*>(handle);
+}
+
+}  // extern "C"
