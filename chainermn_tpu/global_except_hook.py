@@ -24,14 +24,27 @@ _hook_installed = False
 _EXIT_CODE = 13  # distinct from interpreter default 1: "killed by crash barrier"
 
 
-def _handle_uncaught(exc_type, exc_value, exc_traceback):
-    try:
-        import jax
+def _safe_rank():
+    """Process rank WITHOUT initializing a backend: the barrier must never
+    block (backend init can wait on a device claim — the exact hang this
+    hook exists to prevent). Reports -1/-1 unless jax is already live."""
+    import sys
 
-        rank = jax.process_index()
-        size = jax.process_count()
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return -1, -1
+    try:
+        from jax._src import xla_bridge as xb
+
+        if not getattr(xb, "_backends", None):
+            return -1, -1
+        return jax.process_index(), jax.process_count()
     except Exception:
-        rank, size = -1, -1
+        return -1, -1
+
+
+def _handle_uncaught(exc_type, exc_value, exc_traceback):
+    rank, size = _safe_rank()
     sys.stderr.write(
         "\n*****************************************************\n"
         f"chainermn_tpu: uncaught exception on process {rank}/{size};\n"
