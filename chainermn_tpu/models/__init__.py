@@ -2,10 +2,14 @@ from chainermn_tpu.models.mlp import MLP  # noqa: F401
 
 
 def __getattr__(name):
-    if name in ("ResNet50", "ResNet18", "ResNet"):
+    if name in ("ResNet50", "ResNet18", "ResNet101", "ResNet"):
         from chainermn_tpu.models import resnet
 
         return getattr(resnet, name)
+    if name in ("AlexNet", "NiN", "GoogLeNet"):
+        from chainermn_tpu.models import convnets
+
+        return getattr(convnets, name)
     if name in ("Seq2Seq",):
         from chainermn_tpu.models import seq2seq
 

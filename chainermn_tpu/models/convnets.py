@@ -1,0 +1,126 @@
+"""Classic ImageNet convnets — the reference's example-model zoo.
+
+Reference: REF:examples/imagenet/models/ — ``alex.py``, ``nin.py``,
+``googlenet.py`` alongside resnet50 (SURVEY §2.4).  Rebuilt NHWC/bf16 for
+the MXU; architectural intent preserved (AlexNet's big-kernel stem, NiN's
+1×1 mlpconv stacks + global average pooling, GoogLeNet's Inception
+branches) rather than any line-level translation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class AlexNet(nn.Module):
+    """AlexNet (REF:examples/imagenet/models/alex.py)."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = nn.relu(conv(96, (11, 11), strides=(4, 4))(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(256, (5, 5), padding="SAME")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(384, (3, 3), padding="SAME")(x))
+        x = nn.relu(conv(384, (3, 3), padding="SAME")(x))
+        x = nn.relu(conv(256, (3, 3), padding="SAME")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class NiN(nn.Module):
+    """Network-in-Network (REF:examples/imagenet/models/nin.py): mlpconv
+    stacks (conv + two 1×1 convs) and global average pooling."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    def _mlpconv(self, x, features, kernel, strides, name):
+        conv = partial(nn.Conv, dtype=self.dtype)
+        x = nn.relu(conv(features, kernel, strides=strides, name=f"{name}_0")(x))
+        x = nn.relu(conv(features, (1, 1), name=f"{name}_1")(x))
+        x = nn.relu(conv(features, (1, 1), name=f"{name}_2")(x))
+        return x
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = self._mlpconv(x, 96, (11, 11), (4, 4), "mlp1")
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = self._mlpconv(x, 256, (5, 5), (1, 1), "mlp2")
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = self._mlpconv(x, 384, (3, 3), (1, 1), "mlp3")
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = self._mlpconv(x, self.num_classes, (3, 3), (1, 1), "mlp4")
+        x = jnp.mean(x, axis=(1, 2))
+        return x.astype(jnp.float32)
+
+
+class _Inception(nn.Module):
+    n1: int
+    n3r: int
+    n3: int
+    n5r: int
+    n5: int
+    pool_proj: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(nn.Conv, dtype=self.dtype)
+        b1 = nn.relu(conv(self.n1, (1, 1), name="b1")(x))
+        b3 = nn.relu(conv(self.n3r, (1, 1), name="b3r")(x))
+        b3 = nn.relu(conv(self.n3, (3, 3), padding="SAME", name="b3")(b3))
+        b5 = nn.relu(conv(self.n5r, (1, 1), name="b5r")(x))
+        b5 = nn.relu(conv(self.n5, (5, 5), padding="SAME", name="b5")(b5))
+        bp = nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = nn.relu(conv(self.pool_proj, (1, 1), name="bp")(bp))
+        return jnp.concatenate([b1, b3, b5, bp], axis=-1)
+
+
+class GoogLeNet(nn.Module):
+    """GoogLeNet/Inception-v1 (REF:examples/imagenet/models/googlenet.py),
+    sans auxiliary classifiers (a training-era trick superseded by better
+    normalization)."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = nn.relu(conv(64, (7, 7), strides=(2, 2), padding="SAME")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = nn.relu(conv(64, (1, 1))(x))
+        x = nn.relu(conv(192, (3, 3), padding="SAME")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = _Inception(64, 96, 128, 16, 32, 32, self.dtype, name="i3a")(x)
+        x = _Inception(128, 128, 192, 32, 96, 64, self.dtype, name="i3b")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = _Inception(192, 96, 208, 16, 48, 64, self.dtype, name="i4a")(x)
+        x = _Inception(160, 112, 224, 24, 64, 64, self.dtype, name="i4b")(x)
+        x = _Inception(128, 128, 256, 24, 64, 64, self.dtype, name="i4c")(x)
+        x = _Inception(112, 144, 288, 32, 64, 64, self.dtype, name="i4d")(x)
+        x = _Inception(256, 160, 320, 32, 128, 128, self.dtype, name="i4e")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = _Inception(256, 160, 320, 32, 128, 128, self.dtype, name="i5a")(x)
+        x = _Inception(384, 192, 384, 48, 128, 128, self.dtype, name="i5b")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.4, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -103,6 +103,7 @@ class MultiNodeOptimizer:
         batch_spec=None,
         donate: bool = True,
         has_aux: bool = False,
+        rng: Any = None,
     ):
         """Build the jitted SPMD training step.
 
@@ -110,6 +111,10 @@ class MultiNodeOptimizer:
         ``has_aux``) computes the *local* mean loss on one device's batch
         shard; the step averages gradients with the communicator's
         characteristic collective pattern and applies the inner optimizer.
+
+        With ``rng`` (a base PRNGKey), ``loss_fn(params, batch, rng)`` is
+        called with a key folded over (step, device rank) — per-device
+        dropout/augmentation randomness that stays reproducible.
 
         Returns ``step(params, state, batch) -> (params, state, loss[, aux])``.
         """
@@ -120,7 +125,14 @@ class MultiNodeOptimizer:
         opt = self.actual_optimizer
 
         def body(params, state, batch):
-            grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
+            if rng is not None:
+                key = jax.random.fold_in(
+                    jax.random.fold_in(rng, state.step), comm.axis_index()
+                )
+                wrapped = lambda p, b: loss_fn(p, b, key)  # noqa: E731
+            else:
+                wrapped = loss_fn
+            grad_fn = jax.value_and_grad(wrapped, has_aux=has_aux)
             out, grads = grad_fn(params, batch)
             loss, aux = out if has_aux else (out, None)
             loss = lax.pmean(loss, axes)

@@ -1,0 +1,70 @@
+"""Test doubles — the reference's ``chainermn/testing`` stub communicator.
+
+``DummyCommunicator`` pins host-plane topology (``rank``/``size``) and runs
+the object plane locally, so wrapper logic (dataset chunking arithmetic,
+evaluator dict averaging, iterator lockstep) is unit-testable without any
+mesh — exactly the reference's dummy-communicator trick (SURVEY §4
+"unit vs integration").  Device-plane collectives raise: anything touching
+transport belongs in a shard_map integration test on the virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+
+class DummyCommunicator:
+    def __init__(self, rank: int = 0, size: int = 1, peers: Optional[List["DummyCommunicator"]] = None):
+        self.rank = rank
+        self.size = size
+        self._peers = peers  # optional shared mailbox group
+        self._mailbox: dict[str, Any] = {}
+
+    # ---- host/object plane (local semantics) --------------------------
+    def bcast_obj(self, obj, root: int = 0):
+        if self._peers is not None:
+            group = self._peers
+            if self.rank == root:
+                for p in group:
+                    p._mailbox["bcast"] = obj
+            return group[root]._mailbox.get("bcast", obj)
+        return obj
+
+    def gather_obj(self, obj, root: int = 0):
+        return [obj] * self.size if self.size > 1 else [obj]
+
+    def allgather_obj(self, obj):
+        return self.gather_obj(obj)
+
+    def allreduce_obj(self, obj, op=None):
+        result = obj
+        for _ in range(self.size - 1):
+            result = op(result, obj) if op is not None else result + obj
+        return result
+
+    def scatter_obj(self, objs, root: int = 0):
+        return objs[self.rank]
+
+    def barrier(self):
+        pass
+
+    # ---- device plane: explicitly unsupported -------------------------
+    def __getattr__(self, name):
+        if name in (
+            "allreduce", "bcast", "allgather", "alltoall", "reduce_scatter",
+            "scatter", "ppermute", "allreduce_grad", "broadcast_data",
+            "shard_map", "axis_index",
+        ):
+            raise NotImplementedError(
+                f"DummyCommunicator has no device plane ({name}); use a real "
+                "communicator on the virtual CPU mesh for transport tests"
+            )
+        raise AttributeError(name)
+
+
+def dummy_communicators(size: int) -> List[DummyCommunicator]:
+    """A group of dummies sharing a bcast mailbox (one per simulated rank)."""
+    group: List[DummyCommunicator] = []
+    for r in range(size):
+        group.append(DummyCommunicator(rank=r, size=size, peers=group))
+    return group

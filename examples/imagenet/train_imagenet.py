@@ -27,13 +27,16 @@ import optax
 import chainermn_tpu
 from chainermn_tpu.datasets.toy import SyntheticImageDataset, batch_iterator
 from chainermn_tpu.extensions import Evaluator
+from chainermn_tpu.models.convnets import AlexNet, GoogLeNet, NiN
 from chainermn_tpu.models.resnet import ResNet18, ResNet50
 
 
 def main(argv=None):
     p = argparse.ArgumentParser(description="chainermn_tpu ImageNet example")
     p.add_argument("--communicator", default="xla_ici")
-    p.add_argument("--model", default="resnet50", choices=["resnet50", "resnet18"])
+    p.add_argument("--arch", "--model", dest="arch", default="resnet50",
+                   choices=["resnet50", "resnet18", "alex", "nin", "googlenet"],
+                   help="model architecture (reference: train_imagenet.py --arch)")
     p.add_argument("--batchsize", type=int, default=256, help="global batch")
     p.add_argument("--epochs", type=int, default=1)
     p.add_argument("--image-size", type=int, default=224)
@@ -66,12 +69,17 @@ def main(argv=None):
     train = chainermn_tpu.scatter_dataset(train, comm, shuffle=True, seed=42)
     val = chainermn_tpu.scatter_dataset(val, comm)
 
-    model_cls = ResNet50 if args.model == "resnet50" else ResNet18
-    model = model_cls(num_classes=args.num_classes)
+    archs = {
+        "resnet50": ResNet50, "resnet18": ResNet18,
+        "alex": AlexNet, "nin": NiN, "googlenet": GoogLeNet,
+    }
+    model = archs[args.arch](num_classes=args.num_classes)
+    has_bn = args.arch.startswith("resnet")
     variables = model.init(
-        jax.random.PRNGKey(0), jnp.zeros((1, *shape), jnp.float32), train=True
+        jax.random.PRNGKey(0), jnp.zeros((1, *shape), jnp.float32), train=False
     )
-    params, batch_stats = variables["params"], variables["batch_stats"]
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
 
     # Linear-scaling rule with warmup (the reference stack's large-batch
     # recipe): lr = base * (global_batch / 256), warmed up from 0.
@@ -82,25 +90,42 @@ def main(argv=None):
     )
     state = opt.init(params)
 
-    def loss_fn(params, batch_stats, batch):
-        x, y = batch
-        logits, updates = model.apply(
-            {"params": params, "batch_stats": batch_stats},
-            x,
-            train=True,
-            mutable=["batch_stats"],
-        )
-        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
-        return loss, updates["batch_stats"]
+    if has_bn:
+        def loss_fn(params, batch_stats, batch):
+            x, y = batch
+            logits, updates = model.apply(
+                {"params": params, "batch_stats": batch_stats},
+                x,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+            return loss, updates["batch_stats"]
 
-    step = opt.make_train_step_with_state(loss_fn)
+        step = opt.make_train_step_with_state(loss_fn)
+    else:
+        # Dropout architectures: rng threaded per (step, device) by the
+        # optimizer wrapper.
+        def rng_loss_fn(params, batch, rng):
+            x, y = batch
+            logits = model.apply(
+                {"params": params}, x, train=True, rngs={"dropout": rng}
+            )
+            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+        plain_step = opt.make_train_step(rng_loss_fn, rng=jax.random.PRNGKey(7))
+
+        def step(params, state, batch_stats, batch):
+            params, state, loss = plain_step(params, state, batch)
+            return params, state, batch_stats, loss
 
     def metric_fn(params_and_stats, batch):
         params, batch_stats = params_and_stats
         x, y = batch
-        logits = model.apply(
-            {"params": params, "batch_stats": batch_stats}, x, train=False
-        )
+        variables = {"params": params}
+        if has_bn:
+            variables["batch_stats"] = batch_stats
+        logits = model.apply(variables, x, train=False)
         return {
             "val/loss": optax.softmax_cross_entropy_with_integer_labels(logits, y).mean(),
             "val/accuracy": (logits.argmax(-1) == y).mean(),

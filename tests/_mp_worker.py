@@ -1,0 +1,79 @@
+"""Worker for the 2-process jax.distributed harness test.
+
+Run as: python _mp_worker.py <process_id> <num_processes> <coordinator_port>
+Prints "MP_WORKER_OK <rank>" on success; any assertion kills the worker.
+"""
+
+import os
+import sys
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # Force exactly 2 virtual devices per process, replacing any inherited
+    # host_platform_device_count (pytest's conftest sets 8).
+    flags = [
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f
+    ]
+    flags.append("--xla_force_host_platform_device_count=2")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    assert jax.process_index() == pid
+    assert jax.device_count() == 2 * nproc
+
+    import numpy as np
+
+    from chainermn_tpu.communicators import create_communicator
+    from chainermn_tpu.datasets import scatter_dataset
+    from chainermn_tpu.optimizers import create_multi_node_optimizer
+
+    comm = create_communicator("naive")
+    # Host-plane topology: one process per "node" (inter row).
+    assert comm.rank == pid and comm.size == nproc
+    assert comm.device_size == 2 * nproc
+    assert comm.inter_size == nproc and comm.intra_size == 2
+
+    # Object plane across REAL process boundaries (the reference's pickled
+    # MPI transport, here over the jax.distributed DCN analogue).
+    got = comm.bcast_obj({"payload": [1, 2, 3], "from": "rank0"}, root=0)
+    assert got["from"] == "rank0", got
+
+    gathered = comm.gather_obj(("rank", pid))
+    assert gathered == [("rank", i) for i in range(nproc)], gathered
+
+    total = comm.allreduce_obj(pid + 1)
+    assert total == sum(range(1, nproc + 1)), total
+
+    comm.barrier()
+
+    # scatter_dataset: per-process contiguous shards covering everything.
+    shard = scatter_dataset(list(range(10)), comm, shuffle=True, seed=3,
+                            force_equal_length=False)
+    all_idx = comm.gather_obj(sorted(shard.indices.tolist()))
+    merged = sorted(sum(all_idx, []))
+    assert merged == list(range(10)), merged
+
+    # broadcast_params: rank-divergent params replicated from process 0.
+    import jax.numpy as jnp
+
+    opt = create_multi_node_optimizer(__import__("optax").sgd(0.1), comm)
+    params = {"w": jnp.full((3,), float(pid))}
+    params = opt.broadcast_params(params)
+    np.testing.assert_allclose(np.asarray(params["w"]), 0.0)
+
+    print(f"MP_WORKER_OK {pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

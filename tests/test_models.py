@@ -1,0 +1,88 @@
+"""Model-zoo shape/jit tests (tiny configurations)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from chainermn_tpu.models import MLP
+from chainermn_tpu.models.convnets import AlexNet, GoogLeNet, NiN
+from chainermn_tpu.models.resnet import ResNet18
+from chainermn_tpu.models.seq2seq import Seq2seq
+from chainermn_tpu.models.transformer import Transformer, TransformerLM
+from chainermn_tpu.models.vit import ViT
+
+
+def test_mlp():
+    m = MLP(n_units=32, n_out=10)
+    x = jnp.zeros((4, 28, 28))
+    p = m.init(jax.random.PRNGKey(0), x)
+    assert m.apply(p, x).shape == (4, 10)
+
+
+def test_resnet18_with_bn_state():
+    m = ResNet18(num_classes=10)
+    x = jnp.zeros((2, 32, 32, 3))
+    v = m.init(jax.random.PRNGKey(0), x, train=True)
+    assert "batch_stats" in v
+    out, updates = m.apply(v, x, train=True, mutable=["batch_stats"])
+    assert out.shape == (2, 10)
+    assert out.dtype == jnp.float32
+
+
+@pytest.mark.parametrize("cls,size", [(AlexNet, 96), (NiN, 64), (GoogLeNet, 64)])
+def test_convnets(cls, size):
+    m = cls(num_classes=10)
+    x = jnp.zeros((2, size, size, 3))
+    p = m.init(jax.random.PRNGKey(0), x, train=False)
+    out = m.apply(p, x, train=False)
+    assert out.shape == (2, 10)
+    out2 = m.apply(
+        p, x, train=True, rngs={"dropout": jax.random.PRNGKey(1)}
+    )
+    assert out2.shape == (2, 10)
+
+
+def test_transformer_encdec():
+    m = Transformer(vocab=50, d_model=32, n_heads=2, d_ff=64,
+                    n_enc_layers=1, n_dec_layers=1, max_len=16,
+                    dtype=jnp.float32)
+    src = jnp.ones((2, 8), jnp.int32)
+    tgt = jnp.ones((2, 8), jnp.int32)
+    p = m.init(jax.random.PRNGKey(0), src, tgt)
+    assert m.apply(p, src, tgt).shape == (2, 8, 50)
+
+
+def test_transformer_lm():
+    m = TransformerLM(vocab=50, d_model=32, n_heads=2, d_ff=64,
+                      n_layers=1, max_len=16, dtype=jnp.float32)
+    toks = jnp.ones((2, 8), jnp.int32)
+    p = m.init(jax.random.PRNGKey(0), toks)
+    assert m.apply(p, toks).shape == (2, 8, 50)
+
+
+def test_vit():
+    m = ViT(num_classes=10, patch=8, d_model=32, n_heads=2, d_ff=64, n_layers=1)
+    x = jnp.zeros((2, 32, 32, 3))
+    p = m.init(jax.random.PRNGKey(0), x)
+    assert m.apply(p, x).shape == (2, 10)
+
+
+def test_seq2seq():
+    m = Seq2seq(vocab=30, d_model=16, n_layers=1)
+    src = jnp.ones((2, 6), jnp.int32)
+    tgt = jnp.ones((2, 6), jnp.int32)
+    p = m.init(jax.random.PRNGKey(0), src, tgt)
+    assert m.apply(p, src, tgt).shape == (2, 6, 30)
+
+
+def test_dummy_communicator():
+    from chainermn_tpu.testing import DummyCommunicator, dummy_communicators
+
+    d = DummyCommunicator(rank=1, size=4)
+    assert d.allreduce_obj(2) == 8
+    assert d.scatter_obj([0, 10, 20, 30]) == 10
+    with pytest.raises(NotImplementedError):
+        d.allreduce_grad({})
+    group = dummy_communicators(3)
+    group[0].bcast_obj("x", root=0)
+    assert group[2].bcast_obj(None, root=0) == "x"
