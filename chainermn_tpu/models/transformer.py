@@ -168,11 +168,22 @@ class TransformerLM(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, position_offset=None):
+        """``position_offset``: global position of this shard's first token —
+        pass ``axis_index * S_local`` when the sequence dimension is sharded
+        (sequence parallelism); requires a sequence-aware ``attention_fn``
+        (ring/Ulysses), since the dense path's causal mask is local."""
+        import jax.lax as _lax
+
         embed = nn.Embed(self.vocab, self.d_model, dtype=self.dtype, name="embed")
         pe = jnp.asarray(sinusoidal_positions(self.max_len, self.d_model))
-        x = embed(tokens) + pe[None, : tokens.shape[1]].astype(self.dtype)
-        mask = causal_mask(tokens.shape[1])
+        S = tokens.shape[1]
+        if position_offset is None:
+            pos = pe[:S]
+        else:
+            pos = _lax.dynamic_slice_in_dim(pe, position_offset, S, axis=0)
+        x = embed(tokens) + pos[None].astype(self.dtype)
+        mask = causal_mask(S)
         for i in range(self.n_layers):
             x = EncoderLayer(
                 self.d_model, self.n_heads, self.d_ff, self.dtype,

@@ -1,0 +1,107 @@
+"""Expert parallelism — mixture-of-experts with all-to-all token routing.
+
+Net-new (SURVEY §2.5: "EP/MoE: reference has nothing").  The TPU-native
+shape: experts are sharded one-per-device over a mesh axis, tokens are
+routed to their expert's device with ``lax.all_to_all``, expert FFNs run
+batched on the MXU, and a second all-to-all routes results back — the
+standard Switch-style EP layout, built on the same differentiable
+``alltoall`` primitive the reference exposed as a collective Function
+(REF:chainermn/functions/collective_communication.py) without ever using
+it this way.
+
+Capacity-based dispatch keeps shapes static for XLA: each device sends
+exactly ``capacity`` token slots to every expert (padded with zeros,
+weighted 0), so the program is retrace-free regardless of routing skew.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top1_route(gate_logits: jax.Array, n_experts: int, capacity: int):
+    """Top-1 routing with per-(device, expert) capacity.
+
+    gate_logits: (T, E).  Returns (dispatch, combine):
+      dispatch: (E, C, T) one-hot dispatch mask (token t fills slot c of
+                expert e), zeros for dropped/padded slots;
+      combine:  (E, C, T) dispatch × gate probability (the weight used when
+                summing expert outputs back per token).
+    """
+    T, E = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                     # (T,)
+    gate = jnp.max(probs, axis=-1)                          # (T,)
+
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)   # (T, E)
+    # Position of each token within its expert's queue.
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0         # (T, E), -1 elsewhere
+    kept = (pos >= 0) & (pos < capacity)
+
+    slot = jnp.where(kept, pos, 0).astype(jnp.int32)        # (T, E)
+    slot_onehot = (
+        jax.nn.one_hot(slot, capacity, dtype=jnp.float32) * kept[..., None]
+    )                                                       # (T, E, C)
+    # dispatch[e, c, t] = 1 if token t sits in slot c of expert e.
+    dispatch = jnp.einsum("te,tec->ect", onehot, slot_onehot)
+    combine = dispatch * gate[None, None, :]
+    return dispatch, combine
+
+
+def moe_layer(
+    x: jax.Array,
+    gate_w: jax.Array,
+    expert_fn: Callable,
+    expert_params,
+    axis_name: str,
+    capacity_factor: float = 2.0,
+):
+    """Expert-parallel MoE FFN; call inside ``shard_map`` over ``axis_name``.
+
+    ``x``: (T_local, D) this device's tokens.  ``gate_w``: (D, E) router
+    weights (replicated).  ``expert_params``: THIS device's expert's
+    parameters (one expert per device; E = axis size).
+    ``expert_fn(params, tokens) -> tokens`` is the expert computation.
+
+    Returns (T_local, D) with each token replaced by its expert's output
+    weighted by the gate (dropped-by-capacity tokens pass through as zeros,
+    as in Switch)."""
+    E = lax.axis_size(axis_name)
+    T, D = x.shape
+    capacity = max(1, int(capacity_factor * T / E))
+
+    gate_logits = x @ gate_w                                # (T, E)
+    dispatch, combine = top1_route(gate_logits, E, capacity)
+
+    # Gather each expert's slots from local tokens: (E, C, D).
+    expert_in = jnp.einsum("ect,td->ecd", dispatch, x.astype(jnp.float32))
+    # All-to-all: device d ends up with ITS expert's slots from every
+    # device: (E, C, D) → (E, C, D) where leading axis is now source device.
+    expert_in = lax.all_to_all(expert_in, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    # Run the local expert on all (E*C) slots.
+    flat = expert_in.reshape(E * capacity, D).astype(x.dtype)
+    out = expert_fn(expert_params, flat).astype(jnp.float32)
+    out = out.reshape(E, capacity, D)
+    # Route back: leading axis returns to expert-major layout per source.
+    out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    # Combine: token t = sum over (e, c) of combine[e,c,t] * out[e,c,:].
+    return jnp.einsum("ect,ecd->td", combine, out).astype(x.dtype)
+
+
+def dense_moe_oracle(x, gate_w, expert_fn, all_expert_params, capacity_factor=2.0):
+    """Single-device oracle: same routing math with all experts local."""
+    E = gate_w.shape[1]
+    T, D = x.shape
+    capacity = max(1, int(capacity_factor * T / E))
+    dispatch, combine = top1_route(x @ gate_w, E, capacity)
+    expert_in = jnp.einsum("ect,td->ecd", dispatch, x.astype(jnp.float32))
+    outs = []
+    for e in range(E):
+        params_e = jax.tree.map(lambda p: p[e], all_expert_params)
+        outs.append(expert_fn(params_e, expert_in[e].astype(x.dtype)).astype(jnp.float32))
+    out = jnp.stack(outs)
+    return jnp.einsum("ect,ecd->td", combine, out).astype(x.dtype)

@@ -1,0 +1,107 @@
+"""Expert-parallel MoE vs the single-device routing oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from chainermn_tpu.communicators import build_mesh
+from chainermn_tpu.parallel.moe import dense_moe_oracle, moe_layer, top1_route
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+E, D, T_PER_DEV = 4, 8, 16
+
+
+def expert_fn(params, x):
+    return jnp.tanh(x @ params["w"]) @ params["w2"]
+
+
+def make_experts(seed=0):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    return {
+        "w": jax.random.normal(k1, (E, D, 16)) * 0.3,
+        "w2": jax.random.normal(k2, (E, 16, D)) * 0.3,
+    }
+
+
+@pytest.fixture(scope="module")
+def ep_mesh():
+    devs = jax.devices()
+    if len(devs) < E:
+        pytest.skip("needs 4 devices")
+    return build_mesh(inter_size=1, intra_size=E, devices=devs[:E])
+
+
+def test_top1_route_capacity():
+    logits = jnp.array([[5.0, 0.0], [4.0, 0.0], [3.0, 0.0], [0.0, 2.0]])
+    dispatch, combine = top1_route(logits, 2, capacity=2)
+    assert dispatch.shape == (2, 2, 4)
+    # Tokens 0,1 fill expert 0's two slots; token 2 dropped (capacity).
+    assert dispatch[0, 0, 0] == 1 and dispatch[0, 1, 1] == 1
+    assert dispatch[:, :, 2].sum() == 0
+    assert dispatch[1, 0, 3] == 1
+    # Combine weights are gate probs.
+    assert 0 < float(combine[0, 0, 0]) <= 1
+
+
+def test_moe_matches_oracle(ep_mesh):
+    experts = make_experts()
+    gate_w = jax.random.normal(jax.random.PRNGKey(1), (D, E)) * 0.5
+    x = jax.random.normal(jax.random.PRNGKey(2), (E * T_PER_DEV, D))
+
+    def body(x, gate_w, experts):
+        mine = jax.tree.map(lambda p: jnp.squeeze(p, 0), experts)
+        return moe_layer(x, gate_w, expert_fn, mine, "intra",
+                         capacity_factor=4.0)
+
+    f = jax.jit(
+        shard_map(
+            body, mesh=ep_mesh,
+            in_specs=(P("intra"), P(), P("intra")),
+            out_specs=P("intra"),
+            check_vma=False,
+        )
+    )
+    out = f(x, gate_w, experts)
+
+    # Oracle must see the same per-device routing: apply it shard-wise
+    # (routing/capacity are computed per device by design).
+    ref = jnp.concatenate([
+        dense_moe_oracle(
+            x[i * T_PER_DEV:(i + 1) * T_PER_DEV], gate_w, expert_fn, experts,
+            capacity_factor=4.0,
+        )
+        for i in range(E)
+    ])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_moe_gradients_flow(ep_mesh):
+    experts = make_experts()
+    gate_w = jax.random.normal(jax.random.PRNGKey(1), (D, E)) * 0.5
+    x = jax.random.normal(jax.random.PRNGKey(2), (E * T_PER_DEV, D))
+
+    def loss(args):
+        gate_w, experts = args
+
+        def body(x, gate_w, experts):
+            mine = jax.tree.map(lambda p: jnp.squeeze(p, 0), experts)
+            out = moe_layer(x, gate_w, expert_fn, mine, "intra", 4.0)
+            return jnp.sum(out**2)
+
+        f = shard_map(
+            body, mesh=ep_mesh,
+            in_specs=(P("intra"), P(), P("intra")),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return f(x, gate_w, experts)
+
+    g_gate, g_exp = jax.jit(jax.grad(loss))((gate_w, experts))
+    assert float(jnp.abs(g_gate).sum()) > 0
+    assert all(float(jnp.abs(l).sum()) > 0 for l in jax.tree.leaves(g_exp))
