@@ -152,19 +152,28 @@ def flash_attention(
     if scale is None:
         scale = 1.0 / (D**0.5)
 
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
     block_q = min(block_q, Sq)
     block_k = min(block_k, Sk)
+    # Sublane tiling constraint on compiled TPU kernels: the block's
+    # second-to-last dim must be a multiple of the dtype's sublane count
+    # and the last (lane) dim a multiple of 128.  Interpret mode has no
+    # tiling, so the CPU harness can exercise smaller shapes.
+    sublane = 16 if q.dtype == jnp.bfloat16 else 8
+    tile_ok = interpret or (
+        D % 128 == 0 and block_q % sublane == 0 and block_k % sublane == 0
+    )
     usable = (
         _HAS_PLTPU
         and D <= 128
         and Sq % block_q == 0
         and Sk % block_k == 0
+        and tile_ok
     )
     if not usable:
         return _xla_attention(q, k, v, scale, causal)
-
-    if interpret is None:
-        interpret = jax.default_backend() not in ("tpu", "axon")
 
     # (B, S, H, D) → (B*H, S, D)
     qt = q.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)

@@ -331,7 +331,9 @@ class MultiNodeOptimizer:
         compiled = {}
 
         def step(params, state, batch):
-            key = id(jax.tree.structure(params))
+            # PyTreeDefs are hashable and stable — safe cache keys (an id()
+            # of a temporary would be reusable after GC).
+            key = jax.tree.structure(params)
             fn = compiled.get(key)
             if fn is None:
                 fn = compiled[key] = make(params)

@@ -91,11 +91,29 @@ def make_gspmd_train_step(
 
     batch_sharding = NamedSharding(mesh, P(data_axis))
 
+    # Optimizer moments (adam's mu/nu etc.) are param-shaped; shard them
+    # like their parameter so TP actually divides optimizer memory.  Shape
+    # lookup is the association mechanism (first match wins on shape
+    # collisions — all same-shape transformer params shard identically
+    # under these rules, so collisions are benign).
+    shape_to_sharding = {}
+
     def shard_fn(params, opt_state):
+        for p_leaf, s_leaf in zip(
+            jax.tree.leaves(params),
+            jax.tree.leaves(
+                param_shardings,
+                is_leaf=lambda x: isinstance(x, NamedSharding),
+            ),
+        ):
+            shape_to_sharding.setdefault(p_leaf.shape, s_leaf)
         params = jax.device_put(params, param_shardings)
-        # Optimizer state mirrors parameter sharding where shapes match.
+
         def opt_shard(x):
-            return jax.device_put(x, NamedSharding(mesh, P()))
+            sharding = shape_to_sharding.get(
+                getattr(x, "shape", None), NamedSharding(mesh, P())
+            )
+            return jax.device_put(x, sharding)
 
         opt_state = jax.tree.map(opt_shard, opt_state)
         return params, opt_state

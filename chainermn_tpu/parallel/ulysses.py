@@ -51,16 +51,13 @@ def ulysses_attention(
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    S = S_loc * n
 
-    logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", qh.astype(jnp.float32), kh.astype(jnp.float32)
-    ) * scale
-    if causal:
-        mask = jnp.tril(jnp.ones((S, S), bool))
-        logits = jnp.where(mask[None, None], logits, jnp.finfo(jnp.float32).min)
-    weights = jax.nn.softmax(logits)
-    out = jnp.einsum("bhqk,bkhd->bqhd", weights, vh.astype(jnp.float32))
+    # Local compute on the full sequence / head shard: the hot attention op
+    # shared with ops.flash_attention (Pallas kernel where shapes allow,
+    # XLA fallback otherwise — one implementation of the math to maintain).
+    from chainermn_tpu.ops.flash_attention import flash_attention
+
+    out = flash_attention(qh, kh, vh, causal=causal, scale=scale)
     return to_seq(out.astype(q.dtype))
 
 

@@ -127,25 +127,37 @@ class NativeQueue:
             self._h = self._lib.hostbuf_queue_new(capacity)
         else:
             import queue
+            import threading
 
             self._q = queue.Queue(maxsize=capacity)
+            self._closed = threading.Event()
 
     def push(self, data: bytes) -> bool:
         if self._lib is not None:
             return self._lib.hostbuf_queue_push(self._h, data, len(data)) == 0
-        try:
-            self._q.put(data)
-            return True
-        except Exception:
-            return False
+        # Fallback mirrors the C++ contract: push blocks while full, fails
+        # once closed.
+        while not self._closed.is_set():
+            try:
+                self._q.put(data, timeout=0.05)
+                return True
+            except Exception:
+                continue
+        return False
 
     def pop(self, max_len: int) -> bytes:
         if self._lib is not None:
             buf = ctypes.create_string_buffer(max_len)
             n = self._lib.hostbuf_queue_pop(self._h, buf, max_len)
             return buf.raw[:n]
-        item = self._q.get()
-        return item if item is not None else b""
+        while True:
+            try:
+                item = self._q.get(timeout=0.05)
+            except Exception:
+                if self._closed.is_set():
+                    return b""
+                continue
+            return item[:max_len]
 
     def size(self) -> int:
         if self._lib is not None:
@@ -156,7 +168,7 @@ class NativeQueue:
         if self._lib is not None:
             self._lib.hostbuf_queue_close(self._h)
         else:
-            self._q.put(None)
+            self._closed.set()
 
     def __del__(self):
         try:
