@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Allreduce bus-bandwidth micro-benchmark — BASELINE.md's second metric
+("allreduce bus bandwidth: report GB/s over ICI for the gradient-allreduce
+path").
+
+Reference analogue: the relative ranking discussion in the reference's docs
+(pure_nccl > two_dimensional > hierarchical > flat > naive, SURVEY §6) and
+NCCL's own ``all_reduce_perf`` convention: for an allreduce over ``n``
+ranks the *bus bandwidth* is ``2*(n-1)/n * bytes / time`` — the wire-level
+traffic each link actually carries, making numbers comparable across
+device counts.
+
+Runs the REAL gradient-allreduce path of each requested communicator (the
+same ``allreduce_grad`` that ``create_multi_node_optimizer`` traces into
+the train step), jitted via ``shard_map`` over the full mesh, across a
+sweep of buffer sizes.
+
+Usage::
+
+    python benchmarks/allreduce_bench.py                 # all devices, xla_ici
+    python benchmarks/allreduce_bench.py --communicators xla_ici,two_dimensional \
+        --sizes-mb 1,16,64 --dtype bfloat16
+
+On one real chip there is no inter-chip wire, so the number degenerates to
+0 (n=1 → factor 0); use the virtual CPU mesh (``JAX_PLATFORMS=cpu
+XLA_FLAGS=--xla_force_host_platform_device_count=8``) to exercise the
+collective algorithm itself, and a real slice for true ICI GB/s.
+
+Prints one JSON line per (communicator, size) with keys
+{"metric", "communicator", "bytes", "value", "unit", "time_ms"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bench_one(comm, nbytes: int, dtype, iters: int, warmup: int) -> dict:
+    n = comm.device_size
+    elems_per_dev = max(1, nbytes // np.dtype(dtype).itemsize)
+    # The stacked-tree shape eager_allreduce_grad expects: leading
+    # device_size axis, one shard per device.
+    buf = jnp.ones((n, elems_per_dev), dtype=dtype)
+
+    for _ in range(warmup):
+        out = comm.eager_allreduce_grad({"g": buf})
+    jax.block_until_ready(out)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = comm.eager_allreduce_grad({"g": buf})
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+
+    payload = elems_per_dev * np.dtype(dtype).itemsize
+    bus_bw = 2 * (n - 1) / n * payload / dt if n > 1 else 0.0
+    return {
+        "metric": "allreduce_bus_bw",
+        "communicator": comm.name,
+        "devices": n,
+        "bytes": payload,
+        "value": round(bus_bw / 1e9, 4),
+        "unit": "GB/s",
+        "time_ms": round(dt * 1e3, 4),
+        "algo_bw_GBps": round(payload / dt / 1e9, 4),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--communicators", default="xla_ici",
+                    help="comma-separated communicator names")
+    ap.add_argument("--sizes-mb", default="1,4,16,64",
+                    help="comma-separated per-device payload sizes in MiB")
+    ap.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16", "float16"])
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    args = ap.parse_args()
+    if args.iters < 1:
+        ap.error("--iters must be >= 1")
+    if args.warmup < 1:
+        ap.error("--warmup must be >= 1 (first call pays compilation)")
+
+    import chainermn_tpu
+
+    dtype = jnp.dtype(args.dtype)
+    for name in args.communicators.split(","):
+        comm = chainermn_tpu.create_communicator(name.strip())
+        for mb in args.sizes_mb.split(","):
+            nbytes = int(float(mb) * 2**20)
+            row = bench_one(comm, nbytes, dtype, args.iters, args.warmup)
+            print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

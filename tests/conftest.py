@@ -44,3 +44,22 @@ def mesh(request, devices8):
 
     inter, intra = request.param
     return build_mesh(inter_size=inter, intra_size=intra, devices=devices8)
+
+
+def subprocess_env(n_devices: int = 8) -> dict:
+    """Environment for spawning REAL worker/example subprocesses on the
+    virtual CPU mesh: scrub the axon TPU plugin trigger, force the CPU
+    platform, and put the repo root on PYTHONPATH so the in-repo package
+    imports without an installed wheel."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, env.get("PYTHONPATH")) if p
+    )
+    return env

@@ -1,0 +1,86 @@
+"""Example smoke tests — the reference's CI runs MNIST for one epoch with
+the ``naive`` communicator on CPU (SURVEY §4); we do the same for every
+example script, tiny settings, on the virtual 8-device CPU mesh.
+
+Each example is launched as a REAL subprocess (its own argparse entry
+point), exactly as a user would run it — not imported — so the scripts'
+flag handling, logging gates, and ``__main__`` blocks are covered too.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from conftest import subprocess_env
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_EX = os.path.join(_REPO, "examples")
+
+
+def _run(script, *flags, timeout=420):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_EX, script), *flags],
+        capture_output=True, text=True, timeout=timeout,
+        env=subprocess_env(),
+    )
+    assert proc.returncode == 0, (
+        f"{script} failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    return proc.stdout
+
+
+def test_mnist_naive():
+    out = _run(
+        "mnist/train_mnist.py", "--communicator", "naive",
+        "--epochs", "1", "--batchsize", "64", "--unit", "32",
+        "--train-size", "256", "--val-size", "64",
+    )
+    assert "epoch" in out.lower()
+
+
+def test_imagenet_smoke():
+    _run(
+        "imagenet/train_imagenet.py", "--communicator", "xla_ici",
+        "--arch", "resnet18", "--batchsize", "16", "--image-size", "32",
+        "--num-classes", "10", "--train-size", "64", "--val-size", "16",
+        "--steps", "2",
+    )
+
+
+def test_seq2seq_smoke():
+    _run(
+        "seq2seq/seq2seq.py", "--communicator", "naive",
+        "--epochs", "1", "--batchsize", "8", "--unit", "32",
+        "--vocab", "64", "--seq-len", "8", "--train-size", "32",
+    )
+
+
+def test_parallel_convolution_smoke():
+    _run(
+        "parallel_convolution/train_parallel_conv.py",
+        "--communicator", "naive", "--epochs", "1",
+        "--batchsize", "8", "--channels", "16", "--train-size", "32",
+    )
+
+
+@pytest.mark.slow
+def test_wmt_transformer_smoke():
+    _run(
+        "wmt/train_transformer.py", "--communicator", "two_dimensional",
+        "--epochs", "1", "--batchsize", "8", "--d-model", "32",
+        "--n-heads", "2", "--d-ff", "64", "--layers", "1",
+        "--vocab", "64", "--seq-len", "8",
+    )
+
+
+@pytest.mark.slow
+def test_vit_pipeline_smoke():
+    _run(
+        "vit/train_vit.py",
+        "--epochs", "1", "--batchsize", "8", "--image-size", "32",
+        "--patch", "8", "--d-model", "32", "--n-heads", "2",
+        "--d-ff", "64", "--layers-per-stage", "1", "--n-classes", "10",
+        "--microbatches", "2", "--train-size", "16",
+    )

@@ -11,6 +11,8 @@ import sys
 
 import pytest
 
+from conftest import subprocess_env
+
 _WORKER = os.path.join(os.path.dirname(__file__), "_mp_worker.py")
 
 
@@ -23,13 +25,7 @@ def _free_port():
 def test_two_process_object_plane():
     port = _free_port()
     nproc = 2
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in (repo_root, env.get("PYTHONPATH")) if p
-    )
+    env = subprocess_env(n_devices=1)
 
     procs = [
         subprocess.Popen(
