@@ -343,6 +343,28 @@ class CommunicatorBase:
             check_vma=check_vma,
         )
 
+    def global_batch(self, batch):
+        """Assemble the global batch from per-host batches.
+
+        Under JAX one jitted step spans every process, so train steps take
+        the *global* batch — there is no per-rank-batch analogue of the
+        reference's model.  Each host passes the slice its
+        ``scatter_dataset`` shard produced; leaves come back as global
+        ``jax.Array``s sharded along axis 0 over the world
+        (``shape[0] = per_host_batch * process_count``).  Per-host leading
+        axes must be divisible by the host's local device count.
+        Single-process: returns ``batch`` unchanged.
+        """
+        if self.size == 1:
+            return batch
+        from jax.experimental import multihost_utils
+
+        spec = self._world_spec
+        specs = jax.tree.map(lambda _: spec, batch)
+        return multihost_utils.host_local_array_to_global_array(
+            batch, self.mesh, specs
+        )
+
     # ------------------------------------------------------------------
     # Host/object plane (reference pickle-over-MPI *_obj methods)
     # ------------------------------------------------------------------
