@@ -74,16 +74,22 @@ def main():
     x = jnp.asarray(rng.randn(global_batch, *image), jnp.float32)
     y = jnp.asarray(rng.randint(0, 1000, size=global_batch), jnp.int32)
 
-    # Warmup (compile + stabilize).
+    # Warmup (compile + stabilize).  sync() is a device→host readback, NOT
+    # block_until_ready: some PJRT backends report buffers ready at dispatch
+    # time, and a readback is the only barrier that cannot lie.  Each step
+    # consumes the previous step's (donated) params, so the final readback
+    # transitively waits for the whole timed chain.
+    from chainermn_tpu.utils.profiling import sync
+
     for _ in range(3):
         params, state, batch_stats, loss = step(params, state, batch_stats, (x, y))
-    jax.block_until_ready(loss)
+    sync(loss)
 
     n_steps = 10
     t0 = time.perf_counter()
     for _ in range(n_steps):
         params, state, batch_stats, loss = step(params, state, batch_stats, (x, y))
-    jax.block_until_ready(loss)
+    sync(loss)
     dt = time.perf_counter() - t0
 
     ips = global_batch * n_steps / dt

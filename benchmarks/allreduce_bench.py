@@ -36,7 +36,6 @@ import argparse
 import json
 import time
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -48,14 +47,20 @@ def bench_one(comm, nbytes: int, dtype, iters: int, warmup: int) -> dict:
     # device_size axis, one shard per device.
     buf = jnp.ones((n, elems_per_dev), dtype=dtype)
 
+    # Chain each iteration's input to the previous output so the timed loop
+    # is one serial dependency chain, and synchronize with a host readback
+    # (sync) rather than block_until_ready — see profiling.sync's docstring.
+    from chainermn_tpu.utils.profiling import sync
+
+    out = {"g": buf}
     for _ in range(warmup):
-        out = comm.eager_allreduce_grad({"g": buf})
-    jax.block_until_ready(out)
+        out = comm.eager_allreduce_grad(out)
+    sync(out)
 
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = comm.eager_allreduce_grad({"g": buf})
-    jax.block_until_ready(out)
+        out = comm.eager_allreduce_grad(out)
+    sync(out)
     dt = (time.perf_counter() - t0) / iters
 
     payload = elems_per_dev * np.dtype(dtype).itemsize

@@ -17,6 +17,25 @@ from typing import Optional
 import jax
 
 
+def sync(tree):
+    """Hard execution barrier: force every array in ``tree`` to finish
+    executing by reading one element back to the host.
+
+    ``jax.block_until_ready`` only waits for the *buffer* to be ready, and
+    some PJRT backends (notably tunneled/remote plugins) report readiness at
+    dispatch time — timing loops synchronized with it then measure dispatch
+    rather than compute.  A device→host transfer of any output element
+    cannot complete before the producing program does, on every backend.
+    Use this (not ``block_until_ready``) around benchmark timing regions.
+    """
+    import numpy as np
+
+    for leaf in jax.tree.leaves(tree):
+        if hasattr(leaf, "ravel"):
+            np.asarray(jax.device_get(leaf.ravel()[:1]))
+    return tree
+
+
 @contextlib.contextmanager
 def trace(logdir: str = "/tmp/chainermn_tpu_trace"):
     """Capture a device-level profiler trace around the with-block."""

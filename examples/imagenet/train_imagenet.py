@@ -25,6 +25,7 @@ import numpy as np
 import optax
 
 import chainermn_tpu
+from chainermn_tpu.utils.profiling import sync
 from chainermn_tpu.datasets.toy import SyntheticImageDataset, batch_iterator
 from chainermn_tpu.extensions import Evaluator
 from chainermn_tpu.models.convnets import AlexNet, GoogLeNet, NiN
@@ -145,7 +146,7 @@ def main(argv=None):
             last_loss = loss
             if args.steps and n_steps >= args.steps:
                 break
-        jax.block_until_ready(last_loss)
+        sync(last_loss)  # host readback: honest timing on all backends
         dt = time.perf_counter() - t0
 
         metrics = evaluator.evaluate(

@@ -27,6 +27,7 @@ import numpy as np
 import optax
 
 import chainermn_tpu
+from chainermn_tpu.utils.profiling import sync
 from chainermn_tpu.datasets.toy import SyntheticImageDataset, batch_iterator
 from chainermn_tpu.extensions import Evaluator
 from chainermn_tpu.models import MLP
@@ -87,7 +88,7 @@ def main():
             params, state, loss = step(params, state, batch)
             n_seen += batch[0].shape[0]
             last_loss = loss
-        jax.block_until_ready(last_loss)
+        sync(last_loss)  # host readback: honest timing on all backends
         dt = time.perf_counter() - t0
 
         metrics = evaluator.evaluate(

@@ -21,6 +21,7 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 import chainermn_tpu
+from chainermn_tpu.utils.profiling import sync
 from chainermn_tpu.datasets.toy import SyntheticSeqDataset, batch_iterator
 from chainermn_tpu.links import MultiNodeChainList
 from chainermn_tpu.models.seq2seq import Decoder, Encoder, shift_right
@@ -104,7 +105,7 @@ def main(argv=None):
         t0, last = time.perf_counter(), float("nan")
         for batch in batch_iterator(train, args.batchsize, seed=epoch):
             params, opt_state, last = train_step(params, opt_state, batch)
-        jax.block_until_ready(last)
+        sync(last)  # host readback: honest timing on all backends
         if comm.rank == 0:
             print(
                 f"epoch {epoch}: loss {float(last):.4f} "

@@ -32,6 +32,7 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 import chainermn_tpu
+from chainermn_tpu.utils.profiling import sync
 from chainermn_tpu.datasets.toy import SyntheticImageDataset, batch_iterator
 from chainermn_tpu.models.transformer import EncoderLayer
 from chainermn_tpu.parallel.pipeline import spmd_pipeline
@@ -189,7 +190,7 @@ def main(argv=None):
             )
             step_idx += 1
             n_seen += batch[0].shape[0]
-        jax.block_until_ready(last)
+        sync(last)  # host readback: honest timing on all backends
         if comm.rank == 0:
             print(
                 f"epoch {epoch}: loss {float(last):.4f} "

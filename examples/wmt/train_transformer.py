@@ -22,6 +22,7 @@ import numpy as np
 import optax
 
 import chainermn_tpu
+from chainermn_tpu.utils.profiling import sync
 from chainermn_tpu.datasets.toy import SyntheticSeqDataset, batch_iterator
 from chainermn_tpu.models.transformer import Transformer
 
@@ -93,7 +94,7 @@ def main(argv=None):
             n_steps += 1
             if args.steps and n_steps >= args.steps:
                 break
-        jax.block_until_ready(last)
+        sync(last)  # host readback: honest timing on all backends
         dt = time.perf_counter() - t0
         if comm.rank == 0:
             print(
