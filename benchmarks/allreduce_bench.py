@@ -90,8 +90,13 @@ def main():
     args = ap.parse_args()
     if args.iters < 1:
         ap.error("--iters must be >= 1")
-    if args.warmup < 1:
-        ap.error("--warmup must be >= 1 (first call pays compilation)")
+    if args.warmup < 2:
+        ap.error(
+            "--warmup must be >= 2: the first call pays compilation for the "
+            "fresh-buffer input sharding and the second for the chained "
+            "(shard_map-output) sharding; with fewer, a compile lands inside "
+            "the timed loop"
+        )
 
     import chainermn_tpu
 

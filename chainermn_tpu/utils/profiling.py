@@ -27,12 +27,19 @@ def sync(tree):
     rather than compute.  A device→host transfer of any output element
     cannot complete before the producing program does, on every backend.
     Use this (not ``block_until_ready``) around benchmark timing regions.
-    """
-    import numpy as np
 
+    For sharded arrays only one element of one locally-addressable shard is
+    fetched: a whole-array ``device_get`` would gather the global buffer
+    (and raise on multi-process runs where remote shards are not
+    addressable), while one local element is enough to order this host
+    behind the producing program.
+    """
     for leaf in jax.tree.leaves(tree):
-        if hasattr(leaf, "ravel"):
-            np.asarray(jax.device_get(leaf.ravel()[:1]))
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            jax.device_get(shards[0].data.ravel()[:1])
+        elif hasattr(leaf, "ravel"):
+            jax.device_get(leaf.ravel()[:1])
     return tree
 
 
