@@ -46,6 +46,18 @@ from jax.sharding import PartitionSpec as P
 from chainermn_tpu.communicators.base import CommunicatorBase
 
 
+def _check_batch_divisibility(batch, n_dev, n_accum=1):
+    quantum = n_dev * n_accum
+    for leaf in jax.tree.leaves(batch):
+        if hasattr(leaf, "shape") and leaf.shape and leaf.shape[0] % quantum:
+            raise ValueError(
+                f"global batch axis ({leaf.shape[0]}) must be divisible by "
+                f"device count x n_accum ({n_dev} x {n_accum} = {quantum}); "
+                f"pad or drop the remainder (see datasets.toy.batch_iterator "
+                f"drop_last)"
+            )
+
+
 class MultiNodeOptimizerState(NamedTuple):
     inner: Any            # the wrapped optax optimizer's state
     step: jnp.ndarray     # int32 step counter
@@ -65,21 +77,42 @@ class MultiNodeOptimizer:
         double_buffering: bool = False,
         zero_stage: int = 0,
     ):
-        """``zero_stage=1`` shards optimizer state 1/n per device (ZeRO-1):
-        gradients arrive by reduce-scatter, the inner optimizer updates only
-        the local flat shard, and updated parameters are all-gathered — the
-        TPU-native memory optimization the reference never had (its
-        optimizer state was fully replicated per GPU)."""
+        """ZeRO staging (the TPU-native memory ladder the reference never
+        had — its optimizer state, gradients, and parameters were fully
+        replicated per GPU):
+
+        - ``zero_stage=1``: optimizer state sharded 1/n per device.
+          Gradients arrive by reduce-scatter, the inner optimizer updates
+          only the local flat shard, updated parameters are all-gathered.
+        - ``zero_stage=2``: additionally, with gradient accumulation
+          (``n_accum > 1``) each microbatch's gradients are reduce-scattered
+          immediately, so the accumulator is a 1/n shard instead of a full
+          gradient tree.  Without accumulation it is identical to stage 1
+          (inside one fused step XLA never materializes persistent full
+          gradients anyway).
+        - ``zero_stage=3``: master parameters themselves live sharded 1/n
+          per device between steps as one flat fp32 buffer; each step
+          all-gathers them, computes, reduce-scatters gradients, and
+          updates only the local shard.  The train step then takes and
+          returns the flat buffer — use :meth:`shard_params` /
+          :meth:`materialize` to convert to/from the user pytree.
+        """
         self.actual_optimizer = actual_optimizer
         self.communicator = communicator
         self.double_buffering = double_buffering
-        if zero_stage not in (0, 1):
-            raise ValueError("zero_stage must be 0 or 1")
-        if zero_stage == 1 and double_buffering:
+        if zero_stage not in (0, 1, 2, 3):
+            raise ValueError("zero_stage must be 0, 1, 2 or 3")
+        if zero_stage > 0 and double_buffering:
             raise NotImplementedError(
-                "double_buffering + zero_stage=1 not supported together"
+                "double_buffering + zero_stage>0 not supported together"
             )
         self.zero_stage = zero_stage
+        # ZeRO-3 pack metadata: (treedef, [(shape, dtype, size)]) captured by
+        # shard_params/init so the flat buffer can be unpacked without the
+        # original tree in hand.  _z3_jit caches the shard/materialize jits
+        # per metadata so repeated calls don't recompile.
+        self._z3_meta = None
+        self._z3_jit = {}
         # imperative-parity state (setup/update/target)
         self._params = None
         self._state = None
@@ -93,7 +126,9 @@ class MultiNodeOptimizer:
         first-``update`` ``broadcast_data``: parameters are replicated from
         process 0 so every host starts identical."""
         params = self.broadcast_params(params)
-        if self.zero_stage == 1:
+        if self.zero_stage == 3:
+            self._capture_z3_meta(params)
+        if self.zero_stage > 0:
             inner = self._zero_init(params)
         else:
             inner = self.actual_optimizer.init(params)
@@ -154,6 +189,77 @@ class MultiNodeOptimizer:
             )
         )(params)
 
+    # ------------------------------------------------------------------
+    # ZeRO-3 plumbing: params live as ONE flat fp32 buffer sharded P(world)
+    # ------------------------------------------------------------------
+    def _capture_z3_meta(self, params):
+        leaves, treedef = jax.tree.flatten(params)
+        self._z3_meta = (
+            treedef,
+            [(l.shape, l.dtype, l.size) for l in leaves],
+        )
+
+    def _z3_unpack(self, buf):
+        """Unflatten the gathered fp32 buffer back into the user pytree at
+        each leaf's original shape and dtype (the forward-compute copy)."""
+        treedef, metas = self._z3_meta
+        out, off = [], 0
+        for shape, dtype, size in metas:
+            out.append(buf[off : off + size].reshape(shape).astype(dtype))
+            off += size
+        return jax.tree.unflatten(treedef, out)
+
+    def _world_axis(self):
+        comm = self.communicator
+        return comm.axes if len(comm.axes) > 1 else comm.axes[0]
+
+    def _z3_key(self, kind):
+        treedef, metas = self._z3_meta
+        return (kind, treedef, tuple(metas))
+
+    def shard_params(self, params):
+        """ZeRO-3 entry: user pytree → flat fp32 master buffer, one 1/n
+        shard resident per device.  The returned array is what the stage-3
+        train step takes and returns in place of the pytree."""
+        if self.zero_stage != 3:
+            raise ValueError("shard_params is only meaningful for zero_stage=3")
+        comm = self.communicator
+        self._capture_z3_meta(params)
+        n, _, shard_size = self._zero_geometry(params)
+        world = self._world_axis()
+
+        fn = self._z3_jit.get(self._z3_key("shard"))
+        if fn is None:
+
+            def body(tree):
+                flat, _ = self._zero_pack(tree, shard_size * n)
+                return lax.dynamic_slice_in_dim(
+                    flat, comm.axis_index() * shard_size, shard_size
+                )
+
+            fn = jax.jit(comm.shard_map(body, in_specs=(P(),), out_specs=P(world)))
+            self._z3_jit[self._z3_key("shard")] = fn
+        return fn(params)
+
+    def materialize(self, flat):
+        """ZeRO-3 exit: flat sharded master buffer → replicated user pytree
+        (for evaluation, checkpoint export, or leaving stage-3 training)."""
+        if self._z3_meta is None:
+            raise RuntimeError("call shard_params (or init) before materialize")
+        comm = self.communicator
+        world = self._world_axis()
+
+        fn = self._z3_jit.get(self._z3_key("mat"))
+        if fn is None:
+
+            def body(local):
+                full = lax.all_gather(local, world, axis=0, tiled=True)
+                return self._z3_unpack(full)
+
+            fn = jax.jit(comm.shard_map(body, in_specs=(P(world),), out_specs=P()))
+            self._z3_jit[self._z3_key("mat")] = fn
+        return fn(flat)
+
     def broadcast_params(self, params):
         """Host-plane replication from process 0 (reference
         ``broadcast_data``).  A no-op on one host: device-plane replication
@@ -164,6 +270,78 @@ class MultiNodeOptimizer:
             params = multihost_utils.broadcast_one_to_all(params)
         return params
 
+    # ------------------------------------------------------------------
+    # Microbatch gradient machinery shared by every stage
+    # ------------------------------------------------------------------
+    def _make_micro_grad_fn(self, loss_fn, has_aux, rng, loss_scale):
+        """Return ``one(params, microbatch, key) -> (loss, aux, grads)``.
+
+        With ``loss_scale`` the returned gradients are SCALED — they stay
+        scaled through accumulation and the (possibly reduced-precision)
+        collective, preserving small-magnitude structure on the wire, and
+        are unscaled by the caller just before the optimizer update.  The
+        returned loss is always unscaled.
+        """
+
+        def one(params, mb, key):
+            f = loss_fn if key is None else (lambda p, b: loss_fn(p, b, key))
+            if loss_scale is not None:
+                if has_aux:
+                    g = lambda p, b: (  # noqa: E731
+                        lambda o: (o[0] * loss_scale, o[1])
+                    )(f(p, b))
+                else:
+                    g = lambda p, b: f(p, b) * loss_scale  # noqa: E731
+            else:
+                g = f
+            out, grads = jax.value_and_grad(g, has_aux=has_aux)(params, mb)
+            loss, aux = out if has_aux else (out, None)
+            if loss_scale is not None:
+                loss = loss / loss_scale
+            return loss, aux, grads
+
+        return one
+
+    def _split_micro(self, batch, n_accum):
+        """(B, ...) local batch → (n_accum, B/n_accum, ...) microbatches."""
+        return jax.tree.map(
+            lambda x: x.reshape(n_accum, x.shape[0] // n_accum, *x.shape[1:]),
+            batch,
+        )
+
+    def _base_key(self, rng, step):
+        if rng is None:
+            return None
+        return jax.random.fold_in(
+            jax.random.fold_in(rng, step), self.communicator.axis_index()
+        )
+
+    def _accum_local_grads(self, one, params, batch, base_key, n_accum):
+        """Scan the microbatches, accumulating FULL local gradient trees
+        (stages 0 and 1).  Returns (mean_loss, stacked_aux, mean_grads)."""
+        if n_accum == 1:
+            loss, aux, grads = one(
+                params, batch, base_key
+            )
+            return loss, aux, grads
+
+        micro = self._split_micro(batch, n_accum)
+
+        def mb(carry, xs):
+            gacc, lacc = carry
+            i, b = xs
+            key = None if base_key is None else jax.random.fold_in(base_key, i)
+            loss, aux, grads = one(params, b, key)
+            gacc = jax.tree.map(jnp.add, gacc, grads)
+            return (gacc, lacc + loss), aux
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        (gacc, lsum), auxs = lax.scan(
+            mb, (zeros, jnp.zeros((), jnp.float32)), (jnp.arange(n_accum), micro)
+        )
+        grads = jax.tree.map(lambda g: g / n_accum, gacc)
+        return lsum / n_accum, auxs, grads
+
     def make_train_step(
         self,
         loss_fn: Callable,
@@ -171,6 +349,8 @@ class MultiNodeOptimizer:
         donate: bool = True,
         has_aux: bool = False,
         rng: Any = None,
+        n_accum: int = 1,
+        loss_scale: float | None = None,
     ):
         """Build the jitted SPMD training step.
 
@@ -183,6 +363,16 @@ class MultiNodeOptimizer:
         called with a key folded over (step, device rank) — per-device
         dropout/augmentation randomness that stays reproducible.
 
+        ``n_accum > 1`` splits each device's batch shard into that many
+        microbatches and accumulates gradients over a ``lax.scan`` before
+        the collective — same math as the full batch (equal microbatch
+        sizes), bounded activation memory.  With ``has_aux`` the aux is
+        then stacked along a leading ``n_accum`` axis.
+
+        ``loss_scale`` multiplies the loss before differentiation and
+        unscales gradients after communication — parity knob for fp16-style
+        mixed precision (bf16, the TPU default, does not need it).
+
         Returns ``step(params, state, batch) -> (params, state, loss[, aux])``.
         """
         comm = self.communicator
@@ -190,22 +380,22 @@ class MultiNodeOptimizer:
         if batch_spec is None:
             batch_spec = P(axes if len(axes) > 1 else axes[0])
         opt = self.actual_optimizer
-        if self.zero_stage == 1:
+        if n_accum < 1:
+            raise ValueError(f"n_accum must be >= 1, got {n_accum}")
+        if self.zero_stage in (1, 2):
             return self._make_zero_train_step(
-                loss_fn, batch_spec, donate, has_aux, rng
+                loss_fn, batch_spec, donate, has_aux, rng, n_accum, loss_scale
             )
+        if self.zero_stage == 3:
+            return self._make_zero3_train_step(
+                loss_fn, batch_spec, donate, has_aux, rng, n_accum, loss_scale
+            )
+        one = self._make_micro_grad_fn(loss_fn, has_aux, rng, loss_scale)
 
         def body(params, state, batch):
-            if rng is not None:
-                key = jax.random.fold_in(
-                    jax.random.fold_in(rng, state.step), comm.axis_index()
-                )
-                wrapped = lambda p, b: loss_fn(p, b, key)  # noqa: E731
-            else:
-                wrapped = loss_fn
-            grad_fn = jax.value_and_grad(wrapped, has_aux=has_aux)
-            out, grads = grad_fn(params, batch)
-            loss, aux = out if has_aux else (out, None)
+            loss, aux, grads = self._accum_local_grads(
+                one, params, batch, self._base_key(rng, state.step), n_accum
+            )
             loss = lax.pmean(loss, axes)
 
             if self.double_buffering:
@@ -217,6 +407,8 @@ class MultiNodeOptimizer:
 
                 def do_update(operand):
                     params, inner, stale = operand
+                    if loss_scale is not None:
+                        stale = jax.tree.map(lambda g: g / loss_scale, stale)
                     updates, inner = opt.update(stale, inner, params)
                     return optax.apply_updates(params, updates), inner
 
@@ -231,6 +423,8 @@ class MultiNodeOptimizer:
                 )
             else:
                 grads = comm.allreduce_grad(grads)
+                if loss_scale is not None:
+                    grads = jax.tree.map(lambda g: g / loss_scale, grads)
                 updates, inner = opt.update(grads, state.inner, params)
                 params = optax.apply_updates(params, updates)
                 new_state = MultiNodeOptimizerState(
@@ -252,48 +446,90 @@ class MultiNodeOptimizer:
 
         @functools.wraps(jitted)
         def step(params, state, batch):
-            for leaf in jax.tree.leaves(batch):
-                if hasattr(leaf, "shape") and leaf.shape and leaf.shape[0] % n_dev:
-                    raise ValueError(
-                        f"global batch axis ({leaf.shape[0]}) must be divisible "
-                        f"by the communicator's device count ({n_dev}); pad or "
-                        f"drop the remainder (see datasets.toy.batch_iterator "
-                        f"drop_last)"
-                    )
+            _check_batch_divisibility(batch, n_dev, n_accum)
             return jitted(params, state, batch)
 
         return step
 
-    def _make_zero_train_step(self, loss_fn, batch_spec, donate, has_aux, rng):
-        """ZeRO-1 step: reduce-scatter grads → update local flat shard →
+    def _scatter_grads(self, grads, shard_size, n, world):
+        """Pack a full local gradient tree and reduce-scatter it to this
+        device's fp32 flat shard (mean over the world)."""
+        comm = self.communicator
+        gflat, _ = self._zero_pack(grads, shard_size * n)
+        if comm.allreduce_grad_dtype is not None:
+            gflat = gflat.astype(comm.allreduce_grad_dtype)
+        return (
+            lax.psum_scatter(gflat, world, scatter_dimension=0, tiled=True) / n
+        ).astype(jnp.float32)
+
+    def _accum_scattered_grads(
+        self, one, params, batch, base_key, n_accum, shard_size, n, world
+    ):
+        """Scan the microbatches, reduce-scattering each one's gradients and
+        accumulating only the 1/n fp32 shard (ZeRO-2/3).  Returns
+        ``(gshard, mean_loss, aux)``; with ``n_accum == 1`` there is no scan
+        and aux comes back unstacked, matching the stage-0/1 contract."""
+        if n_accum == 1:
+            loss, aux, grads = one(params, batch, base_key)
+            return self._scatter_grads(grads, shard_size, n, world), loss, aux
+
+        micro = self._split_micro(batch, n_accum)
+
+        def mb(carry, xs):
+            sacc, lacc = carry
+            i, b = xs
+            key = None if base_key is None else jax.random.fold_in(base_key, i)
+            loss, aux, grads = one(params, b, key)
+            sacc = sacc + self._scatter_grads(grads, shard_size, n, world)
+            return (sacc, lacc + loss), aux
+
+        (sacc, lsum), aux = lax.scan(
+            mb,
+            (jnp.zeros((shard_size,), jnp.float32),
+             jnp.zeros((), jnp.float32)),
+            (jnp.arange(n_accum), micro),
+        )
+        return sacc / n_accum, lsum / n_accum, aux
+
+    def _make_zero_train_step(
+        self, loss_fn, batch_spec, donate, has_aux, rng, n_accum, loss_scale
+    ):
+        """ZeRO-1/2 step: reduce-scatter grads → update local flat shard →
         all-gather params.  Communication volume equals one allreduce
         (reduce-scatter + all-gather IS a ring allreduce split in half), so
         this costs nothing extra on the wire while dividing optimizer-state
-        memory by the world size."""
+        memory by the world size.
+
+        Stage 2 (only distinct under gradient accumulation): each
+        microbatch's gradients are reduce-scattered inside the scan and only
+        the 1/n fp32 shard is accumulated — gradient-accumulator memory
+        drops from a full tree to ``total/n`` at the price of ``n_accum``
+        smaller collectives instead of one (same total bytes on the wire,
+        more latency terms).
+        """
         comm = self.communicator
         axes = comm.axes
-        world = axes if len(axes) > 1 else axes[0]
+        world = self._world_axis()
         opt = self.actual_optimizer
+        one = self._make_micro_grad_fn(loss_fn, has_aux, rng, loss_scale)
+        per_micro_scatter = self.zero_stage == 2 and n_accum > 1
 
         def body(params, state, batch):
-            if rng is not None:
-                key = jax.random.fold_in(
-                    jax.random.fold_in(rng, state.step), comm.axis_index()
-                )
-                wrapped = lambda p, b: loss_fn(p, b, key)  # noqa: E731
-            else:
-                wrapped = loss_fn
-            out, grads = jax.value_and_grad(wrapped, has_aux=has_aux)(params, batch)
-            loss, aux = out if has_aux else (out, None)
-            loss = lax.pmean(loss, axes)
-
             n, total, shard_size = self._zero_geometry(params)
-            gflat, _ = self._zero_pack(grads, shard_size * n)
-            if comm.allreduce_grad_dtype is not None:
-                gflat = gflat.astype(comm.allreduce_grad_dtype)
-            gshard = (
-                lax.psum_scatter(gflat, world, scatter_dimension=0, tiled=True) / n
-            ).astype(jnp.float32)
+            base_key = self._base_key(rng, state.step)
+
+            if per_micro_scatter:
+                gshard, loss, aux = self._accum_scattered_grads(
+                    one, params, batch, base_key, n_accum, shard_size, n, world
+                )
+            else:
+                loss, aux, grads = self._accum_local_grads(
+                    one, params, batch, base_key, n_accum
+                )
+                gshard = self._scatter_grads(grads, shard_size, n, world)
+            loss = lax.pmean(loss, axes)
+            if loss_scale is not None:
+                gshard = gshard / loss_scale
 
             pflat, unpack = self._zero_pack(params, shard_size * n)
             pshard = lax.dynamic_slice_in_dim(
@@ -333,11 +569,91 @@ class MultiNodeOptimizer:
         def step(params, state, batch):
             # PyTreeDefs are hashable and stable — safe cache keys (an id()
             # of a temporary would be reusable after GC).
+            _check_batch_divisibility(batch, comm.device_size, n_accum)
             key = jax.tree.structure(params)
             fn = compiled.get(key)
             if fn is None:
                 fn = compiled[key] = make(params)
             return fn(params, state, batch)
+
+        return step
+
+    def _make_zero3_train_step(
+        self, loss_fn, batch_spec, donate, has_aux, rng, n_accum, loss_scale
+    ):
+        """ZeRO-3 step: master parameters are ONE flat fp32 buffer sharded
+        1/n per device *between* steps.  Each step all-gathers the buffer,
+        unpacks it into the user pytree at compute dtype, runs fwd/bwd,
+        reduce-scatters gradients, and updates only the local shard — the
+        returned buffer is again 1/n resident per device.
+
+        Per-step wire cost is one all-gather (params) + one reduce-scatter
+        (grads) = the volume of one ring allreduce; the gathered compute
+        copy is transient within the step (XLA frees it after backward), so
+        persistent parameter + optimizer memory is ``O(total/n)``.
+
+        The step signature is ``step(flat_params, state, batch)`` with
+        ``flat_params`` from :meth:`shard_params`; recover the pytree with
+        :meth:`materialize`.
+        """
+        comm = self.communicator
+        axes = comm.axes
+        world = self._world_axis()
+        opt = self.actual_optimizer
+        one = self._make_micro_grad_fn(loss_fn, has_aux, rng, loss_scale)
+
+        def body(pshard, state, batch):
+            n = comm.device_size
+            shard_size = pshard.shape[0]
+            pfull = lax.all_gather(pshard, world, axis=0, tiled=True)
+            params = self._z3_unpack(pfull)
+            base_key = self._base_key(rng, state.step)
+            gshard, loss, aux = self._accum_scattered_grads(
+                one, params, batch, base_key, n_accum, shard_size, n, world
+            )
+            loss = lax.pmean(loss, axes)
+            if loss_scale is not None:
+                gshard = gshard / loss_scale
+
+            updates, inner = opt.update(gshard, state.inner, pshard)
+            new_pshard = optax.apply_updates(pshard, updates)
+            new_state = MultiNodeOptimizerState(
+                inner=inner, step=state.step + 1, comm_buf=()
+            )
+            if has_aux:
+                return new_pshard, new_state, loss, aux
+            return new_pshard, new_state, loss
+
+        def make(flat_example):
+            shard = flat_example.shape[0] // comm.device_size
+            state_spec = MultiNodeOptimizerState(
+                inner=self._zero_inner_spec(shard), step=P(), comm_buf=(),
+            )
+            n_out = 4 if has_aux else 3
+            mapped = comm.shard_map(
+                body,
+                in_specs=(P(world), state_spec, batch_spec),
+                out_specs=(P(world), state_spec) + (P(),) * (n_out - 2),
+            )
+            return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
+
+        compiled = {}
+
+        def step(flat_params, state, batch):
+            if self._z3_meta is None:
+                raise RuntimeError(
+                    "zero_stage=3: call init(params) (or shard_params) first"
+                )
+            _check_batch_divisibility(batch, comm.device_size, n_accum)
+            # The traced body bakes in the unpack metadata, so the cache key
+            # must include it — same padded size with a different tree
+            # layout must re-trace, not silently reuse the wrong unpacking.
+            treedef, metas = self._z3_meta
+            key = (flat_params.shape, treedef, tuple(metas))
+            fn = compiled.get(key)
+            if fn is None:
+                fn = compiled[key] = make(flat_params)
+            return fn(flat_params, state, batch)
 
         return step
 
@@ -400,6 +716,12 @@ class MultiNodeOptimizer:
     # Imperative parity API (reference: optimizer.setup(model) + update())
     # ------------------------------------------------------------------
     def setup(self, params, loss_fn: Callable, batch_spec=None):
+        if self.zero_stage == 3:
+            raise NotImplementedError(
+                "the imperative setup()/update() surface does not support "
+                "zero_stage=3 (the step trades in a flat sharded buffer); "
+                "use init/shard_params/make_train_step/materialize directly"
+            )
         self._params = self.broadcast_params(params)
         self._state = self.init(self._params)
         self._step_fn = self.make_train_step(
@@ -434,7 +756,8 @@ def create_multi_node_optimizer(
     zero_stage: int = 0,
 ) -> MultiNodeOptimizer:
     """Reference-parity factory (REF:chainermn/optimizers.py), extended
-    with ``zero_stage=1`` optimizer-state sharding."""
+    with ZeRO sharding: ``zero_stage=1`` (optimizer state), ``2`` (+ sharded
+    gradient accumulation), ``3`` (+ sharded master parameters)."""
     return MultiNodeOptimizer(
         actual_optimizer,
         communicator,

@@ -178,3 +178,181 @@ def test_zero1_rejects_double_buffering(mesh):
         create_multi_node_optimizer(
             optax.sgd(0.1), comm, double_buffering=True, zero_stage=1
         )
+
+
+@pytest.mark.parametrize("n_accum", [2, 4])
+def test_grad_accumulation_matches_full_batch(mesh, n_accum):
+    """Equal-size microbatches: mean-of-means == full-batch mean, so the
+    accumulated trajectory must match the unaccumulated one exactly."""
+    params, batch = make_problem()
+    comm = create_communicator("xla_ici", mesh=mesh)
+
+    a_opt = create_multi_node_optimizer(optax.sgd(0.1, momentum=0.9), comm)
+    a_state = a_opt.init(params)
+    a_step = a_opt.make_train_step(loss_fn, donate=False, n_accum=n_accum)
+
+    r_opt = create_multi_node_optimizer(optax.sgd(0.1, momentum=0.9), comm)
+    r_state = r_opt.init(params)
+    r_step = r_opt.make_train_step(loss_fn, donate=False)
+
+    ap, rp = params, params
+    for _ in range(3):
+        ap, a_state, a_loss = a_step(ap, a_state, batch)
+        rp, r_state, r_loss = r_step(rp, r_state, batch)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(ap[k]), np.asarray(rp[k]), rtol=1e-5, atol=1e-6
+        )
+    np.testing.assert_allclose(float(a_loss), float(r_loss), rtol=1e-5)
+
+
+def test_grad_accumulation_rejects_indivisible(mesh):
+    params, batch = make_problem(n=64)
+    comm = create_communicator("xla_ici", mesh=mesh)
+    opt = create_multi_node_optimizer(optax.sgd(0.1), comm)
+    state = opt.init(params)
+    step = opt.make_train_step(loss_fn, donate=False, n_accum=3)
+    with pytest.raises(ValueError, match="divisible"):
+        step(params, state, batch)  # 64 % (8*3) != 0
+
+
+def test_loss_scale_invariant_for_sgd(mesh):
+    """SGD is linear in the gradients, so scale-then-unscale must be exact
+    (loss reported unscaled)."""
+    params, batch = make_problem()
+    comm = create_communicator("xla_ici", mesh=mesh)
+
+    s_opt = create_multi_node_optimizer(optax.sgd(0.1), comm)
+    s_state = s_opt.init(params)
+    s_step = s_opt.make_train_step(loss_fn, donate=False, loss_scale=1024.0)
+
+    r_opt = create_multi_node_optimizer(optax.sgd(0.1), comm)
+    r_state = r_opt.init(params)
+    r_step = r_opt.make_train_step(loss_fn, donate=False)
+
+    sp, rp = params, params
+    for _ in range(3):
+        sp, s_state, s_loss = s_step(sp, s_state, batch)
+        rp, r_state, r_loss = r_step(rp, r_state, batch)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(sp[k]), np.asarray(rp[k]), rtol=1e-4, atol=1e-5
+        )
+    np.testing.assert_allclose(float(s_loss), float(r_loss), rtol=1e-5)
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "adam"])
+def test_zero2_matches_zero1_under_accumulation(mesh, opt_name):
+    """ZeRO-2's per-microbatch reduce-scatter accumulation must produce the
+    same trajectory as ZeRO-1's full-tree accumulation."""
+    make_opt = (
+        lambda: optax.sgd(0.1, momentum=0.9)
+        if opt_name == "sgd"
+        else optax.adam(1e-2)
+    )
+    params, batch = make_problem()
+    comm = create_communicator("xla_ici", mesh=mesh)
+
+    p1, p2 = params, params
+    o1 = create_multi_node_optimizer(make_opt(), comm, zero_stage=1)
+    s1 = o1.init(params)
+    st1 = o1.make_train_step(loss_fn, donate=False, n_accum=2)
+    o2 = create_multi_node_optimizer(make_opt(), comm, zero_stage=2)
+    s2 = o2.init(params)
+    st2 = o2.make_train_step(loss_fn, donate=False, n_accum=2)
+
+    for _ in range(4):
+        p1, s1, l1 = st1(p1, s1, batch)
+        p2, s2, l2 = st2(p2, s2, batch)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(p1[k]), np.asarray(p2[k]), rtol=1e-5, atol=1e-6
+        )
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "adam"])
+def test_zero3_matches_replicated(mesh, opt_name):
+    """ZeRO-3 (sharded master params) must track the replicated trajectory;
+    the resident flat buffer must be 1/n per device."""
+    make_opt = (
+        lambda: optax.sgd(0.1, momentum=0.9)
+        if opt_name == "sgd"
+        else optax.adam(1e-2)
+    )
+    params, batch = make_problem()
+    comm = create_communicator("xla_ici", mesh=mesh)
+
+    z_opt = create_multi_node_optimizer(make_opt(), comm, zero_stage=3)
+    z_state = z_opt.init(params)
+    flat = z_opt.shard_params(params)
+    z_step = z_opt.make_train_step(loss_fn, donate=False)
+
+    r_opt = create_multi_node_optimizer(make_opt(), comm)
+    r_state = r_opt.init(params)
+    r_step = r_opt.make_train_step(loss_fn, donate=False)
+
+    rp = params
+    for _ in range(4):
+        flat, z_state, z_loss = z_step(flat, z_state, batch)
+        rp, r_state, r_loss = r_step(rp, r_state, batch)
+
+    zp = z_opt.materialize(flat)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(zp[k]), np.asarray(rp[k]), rtol=1e-5, atol=1e-6
+        )
+    np.testing.assert_allclose(float(z_loss), float(r_loss), rtol=1e-5)
+
+    # Sharding claim: the flat master buffer is split across all devices.
+    n = comm.device_size
+    total = sum(l.size for l in jax.tree.leaves(params))
+    assert flat.size == -(-total // n) * n
+    assert len({s.device for s in flat.addressable_shards}) == n
+    assert all(s.data.size == flat.size // n for s in flat.addressable_shards)
+
+
+def test_zero3_with_grad_accum_and_rng(mesh):
+    """Stage 3 composes with n_accum and per-step rng (smoke + descent)."""
+    params, batch = make_problem(n=64)
+    comm = create_communicator("xla_ici", mesh=mesh)
+
+    def noisy_loss(p, b, key):
+        x, y = b
+        pred = x @ p["w"] + p["b"]
+        return jnp.mean((pred - y) ** 2) + 0.0 * jax.random.normal(key, ())
+
+    opt = create_multi_node_optimizer(optax.adam(1e-2), comm, zero_stage=3)
+    state = opt.init(params)
+    flat = opt.shard_params(params)
+    step = opt.make_train_step(
+        noisy_loss, donate=False, n_accum=2, rng=jax.random.PRNGKey(0)
+    )
+    l0 = None
+    for i in range(10):
+        flat, state, loss = step(flat, state, batch)
+        if i == 0:
+            l0 = float(loss)
+    assert float(loss) < l0
+
+
+def test_zero3_setup_rejected(mesh):
+    comm = create_communicator("xla_ici", mesh=mesh)
+    opt = create_multi_node_optimizer(optax.sgd(0.1), comm, zero_stage=3)
+    params, _ = make_problem()
+    with pytest.raises(NotImplementedError, match="zero_stage=3"):
+        opt.setup(params, loss_fn)
+
+
+def test_zero3_materialize_is_cached(mesh):
+    """Repeated materialize/shard_params must reuse one jitted fn, not
+    rebuild (and recompile) a fresh closure per call."""
+    comm = create_communicator("xla_ici", mesh=mesh)
+    opt = create_multi_node_optimizer(optax.sgd(0.1), comm, zero_stage=3)
+    params, _ = make_problem()
+    flat = opt.shard_params(params)
+    opt.materialize(flat)
+    assert len(opt._z3_jit) == 2
+    flat2 = opt.shard_params(params)
+    opt.materialize(flat2)
+    assert len(opt._z3_jit) == 2  # cache hit, no new entries
