@@ -273,7 +273,7 @@ class MultiNodeOptimizer:
     # ------------------------------------------------------------------
     # Microbatch gradient machinery shared by every stage
     # ------------------------------------------------------------------
-    def _make_micro_grad_fn(self, loss_fn, has_aux, rng, loss_scale):
+    def _make_micro_grad_fn(self, loss_fn, has_aux, loss_scale):
         """Return ``one(params, microbatch, key) -> (loss, aux, grads)``.
 
         With ``loss_scale`` the returned gradients are SCALED — they stay
@@ -390,7 +390,7 @@ class MultiNodeOptimizer:
             return self._make_zero3_train_step(
                 loss_fn, batch_spec, donate, has_aux, rng, n_accum, loss_scale
             )
-        one = self._make_micro_grad_fn(loss_fn, has_aux, rng, loss_scale)
+        one = self._make_micro_grad_fn(loss_fn, has_aux, loss_scale)
 
         def body(params, state, batch):
             loss, aux, grads = self._accum_local_grads(
@@ -511,7 +511,7 @@ class MultiNodeOptimizer:
         axes = comm.axes
         world = self._world_axis()
         opt = self.actual_optimizer
-        one = self._make_micro_grad_fn(loss_fn, has_aux, rng, loss_scale)
+        one = self._make_micro_grad_fn(loss_fn, has_aux, loss_scale)
         per_micro_scatter = self.zero_stage == 2 and n_accum > 1
 
         def body(params, state, batch):
@@ -600,7 +600,7 @@ class MultiNodeOptimizer:
         axes = comm.axes
         world = self._world_axis()
         opt = self.actual_optimizer
-        one = self._make_micro_grad_fn(loss_fn, has_aux, rng, loss_scale)
+        one = self._make_micro_grad_fn(loss_fn, has_aux, loss_scale)
 
         def body(pshard, state, batch):
             n = comm.device_size
@@ -678,6 +678,11 @@ class MultiNodeOptimizer:
             raise NotImplementedError(
                 "double_buffering with mutable model state is not supported "
                 "yet; use make_train_step or double_buffering=False"
+            )
+        if self.zero_stage > 0:
+            raise NotImplementedError(
+                "make_train_step_with_state does not support zero_stage>0 "
+                "yet; use make_train_step (stateless loss) with ZeRO"
             )
         comm = self.communicator
         axes = comm.axes

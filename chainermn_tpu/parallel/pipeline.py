@@ -58,8 +58,7 @@ def spmd_pipeline(
     perm = [(i, (i + 1) % n) for i in range(n)]
     T = n_microbatches + n - 1
 
-    def tick(carry, t):
-        state, outputs = carry
+    def tick(state, t):
         # Stage 0 ingests microbatch t (zeros once the batch is drained);
         # other stages consume the activation shifted from their neighbor.
         feed = jnp.where(
@@ -71,26 +70,181 @@ def spmd_pipeline(
         )
         inp = jnp.where(idx == 0, feed, state)
         y = stage_fn(stage_params, inp)
-        # Last stage: microbatch t - (n-1) completes at tick t.
-        out_slot = t - (n - 1)
-        outputs = lax.cond(
-            out_slot >= 0,
-            lambda o: lax.dynamic_update_index_in_dim(
-                o, jnp.where(idx == n - 1, y, jnp.zeros_like(y)),
-                jnp.maximum(out_slot, 0), axis=0,
-            ),
-            lambda o: o,
-            outputs,
-        )
         state = lax.ppermute(y, axis_name, perm)
-        return (state, outputs), None
+        # Emit this tick's last-stage output as a scan ys (NOT a carried
+        # buffer: a carried (M, ...) output array would be saved per tick
+        # by reverse-mode AD, turning O(M) memory into O(M*T)).
+        out = jnp.where(idx == n - 1, y, jnp.zeros_like(y))
+        return state, out
 
     state0 = jnp.zeros_like(micro[0])
-    outputs0 = jnp.zeros_like(micro)
-    (_, outputs), _ = lax.scan(
-        jax.checkpoint(tick), (state0, outputs0), jnp.arange(T)
-    )
+    _, ys = lax.scan(jax.checkpoint(tick), state0, jnp.arange(T))
+    # Microbatch m completes on the last stage at tick m + n - 1.
+    outputs = ys[n - 1 :]
     return outputs.reshape(B, *x.shape[1:])
+
+
+def pipeline_1f1b_loss_and_grads(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    stage_params,
+    x,
+    target,
+    axis_name: str,
+    n_microbatches: int,
+    loss_params=None,
+    with_input_grads: bool = False,
+):
+    """1F1B-style pipelined forward AND backward in one scan, with explicit
+    vjp bookkeeping — no ``jax.grad`` over the schedule.
+
+    Why it exists: differentiating :func:`spmd_pipeline` gives the GPipe
+    schedule — ALL forwards run (saving one residual per tick, ``O(M + n)``
+    of them), then all backwards.  This function interleaves two SPMD
+    wavefronts instead: at global tick ``t`` stage ``s`` runs the forward
+    of microbatch ``t - s`` and the backward of microbatch
+    ``t - 2(n-1) + s``.  A microbatch's backward trails its forward on the
+    same stage by ``2(n-1-s)`` ticks, so at most ``2n - 1`` saved stage
+    *inputs* are live per device (a static ring buffer), independent of the
+    microbatch count — the 1F1B memory bound.  Backward recomputes the
+    stage forward from the saved input (per-microbatch remat, the same
+    trade ``jax.checkpoint`` makes in the GPipe path).
+
+    Timeline: ``M + 2(n-1)`` ticks, each doing one forward plus one
+    recompute+backward, versus the GPipe path's ``M + n - 1`` forward
+    ticks followed by ``M + n - 1`` recompute+backward ticks — comparable
+    bubble, but peak activation memory ``O(n)`` instead of ``O(M + n)``,
+    so the microbatch count can grow to shrink the bubble without
+    growing memory.
+
+    ``loss_fn(final_activation, target_microbatch) -> scalar`` (mean over
+    the microbatch).  Returns ``(mean_loss, stage_grads)`` where ``loss``
+    is replicated across stages and ``stage_grads`` matches
+    ``stage_params`` — each device holding the gradients of ITS stage, the
+    natural sharding for a pipeline-parallel optimizer.
+
+    Composition with surrounding layers (a head above the pipeline, an
+    embedding below it):
+
+    - ``loss_params``: when given, ``loss_fn(loss_params, y, target)`` —
+      the classifier/head runs INSIDE the schedule (where 1F1B needs it:
+      each microbatch's backward starts the tick its forward ends) and its
+      gradients are appended to the return:
+      ``(loss, stage_grads, loss_param_grads)``, the latter nonzero on the
+      last stage (psum over the axis before use).
+    - ``with_input_grads=True``: additionally append ``input_grads`` of
+      shape ``x.shape`` — the cotangent of the pipeline input, nonzero on
+      stage 0 (psum before use) — to feed an embedding's ``jax.vjp``
+      outside the schedule.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    M = n_microbatches
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by n_microbatches {M}")
+    mb = B // M
+    micro = x.reshape(M, mb, *x.shape[1:])
+    tmicro = target.reshape(M, mb, *target.shape[1:])
+
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    bwd_perm = [((i + 1) % n, i) for i in range(n)]
+    K = 2 * n - 1          # ring slots: fwd/bwd lag is at most 2(n-1) < K
+    T = M + 2 * (n - 1)
+
+    def fwd_only(p, xin):
+        return stage_fn(p, xin)
+
+    if loss_params is None:
+        def loss_and_cotangents(y, tgt):
+            mloss, gy = jax.value_and_grad(loss_fn)(y, tgt)
+            return mloss, gy, ()
+    else:
+        def loss_and_cotangents(y, tgt):
+            mloss, (ghp, gy) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                loss_params, y, tgt
+            )
+            return mloss, gy, ghp
+
+    def tick(carry, t):
+        fwd_state, bwd_grad, ring, gacc, hacc, lacc = carry
+
+        # ---- forward wavefront: microbatch mf = t - idx ----
+        mf = t - idx
+        active_f = jnp.logical_and(mf >= 0, mf < M)
+        feed = lax.dynamic_index_in_dim(
+            micro, jnp.clip(mf, 0, M - 1), keepdims=False
+        )
+        xin = jnp.where(idx == 0, feed, fwd_state)
+        y = stage_fn(stage_params, xin)
+        # Save the stage input for this microbatch's backward.  Inactive
+        # ticks (fill/drain) must leave the ring untouched: the clipped
+        # slot index aliases slot 0 / M-1, whose saved input a trailing
+        # backward may not have consumed yet.
+        ring = jnp.where(
+            active_f,
+            lax.dynamic_update_index_in_dim(
+                ring, xin, jnp.clip(mf, 0, M - 1) % K, axis=0
+            ),
+            ring,
+        )
+
+        # Last stage: this tick's forward microbatch IS this tick's
+        # backward microbatch (mb_idx == mf there); compute the loss and
+        # its output-cotangent now.
+        tgt = lax.dynamic_index_in_dim(
+            tmicro, jnp.clip(mf, 0, M - 1), keepdims=False
+        )
+        mloss, gy_last, ghp = loss_and_cotangents(y, tgt)
+        last_active = jnp.logical_and(active_f, idx == n - 1)
+        lacc = lacc + jnp.where(last_active, mloss, 0.0)
+        hacc = jax.tree.map(
+            lambda a, g: a + jnp.where(last_active, g / M, jnp.zeros_like(g)),
+            hacc, ghp,
+        )
+
+        # ---- backward wavefront: microbatch mb_idx = t - 2(n-1) + idx ----
+        mb_idx = t - 2 * (n - 1) + idx
+        active_b = jnp.logical_and(mb_idx >= 0, mb_idx < M)
+        x_saved = lax.dynamic_index_in_dim(
+            ring, jnp.clip(mb_idx, 0, M - 1) % K, keepdims=False
+        )
+        _, vjp = jax.vjp(fwd_only, stage_params, x_saved)
+        g_in = jnp.where(idx == n - 1, gy_last / M, bwd_grad)
+        gp, gx = vjp(g_in)
+        gacc = jax.tree.map(
+            lambda a, g: a + jnp.where(active_b, g, jnp.zeros_like(g)),
+            gacc, gp,
+        )
+
+        # ---- shifts for the next tick ----
+        gx_masked = jnp.where(active_b, gx, jnp.zeros_like(gx))
+        fwd_state = lax.ppermute(y, axis_name, fwd_perm)
+        bwd_grad = lax.ppermute(gx_masked, axis_name, bwd_perm)
+        # Stage 0's input cotangent, emitted as a scan output (microbatch m
+        # completes its stage-0 backward at tick m + 2(n-1)).
+        gx_out = jnp.where(idx == 0, gx_masked, jnp.zeros_like(gx_masked))
+        return (fwd_state, bwd_grad, ring, gacc, hacc, lacc), gx_out
+
+    carry0 = (
+        jnp.zeros_like(micro[0]),                      # fwd activation in
+        jnp.zeros_like(micro[0]),                      # bwd cotangent in
+        jnp.zeros((K, mb, *x.shape[1:]), x.dtype),     # saved-input ring
+        jax.tree.map(jnp.zeros_like, stage_params),    # param grad accum
+        () if loss_params is None
+        else jax.tree.map(jnp.zeros_like, loss_params),  # head grad accum
+        jnp.zeros((), jnp.float32),                    # loss accum
+    )
+    # No jax.checkpoint here: nothing differentiates *through* this scan —
+    # the backward is explicit inside each tick.
+    (_, _, _, gacc, hacc, lacc), gx_ys = lax.scan(tick, carry0, jnp.arange(T))
+    loss = lax.psum(lacc / M, axis_name)
+    out = (loss, gacc)
+    if loss_params is not None:
+        out = out + (hacc,)
+    if with_input_grads:
+        out = out + (gx_ys[2 * (n - 1) :].reshape(B, *x.shape[1:]),)
+    return out
 
 
 def pipeline_forward_and_loss(

@@ -35,7 +35,10 @@ import chainermn_tpu
 from chainermn_tpu.utils.profiling import sync
 from chainermn_tpu.datasets.toy import SyntheticImageDataset, batch_iterator
 from chainermn_tpu.models.transformer import EncoderLayer
-from chainermn_tpu.parallel.pipeline import spmd_pipeline
+from chainermn_tpu.parallel.pipeline import (
+    pipeline_1f1b_loss_and_grads,
+    spmd_pipeline,
+)
 
 import flax.linen as nn
 
@@ -91,6 +94,9 @@ def main(argv=None):
     p.add_argument("--train-size", type=int, default=1024)
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--no-double-buffering", action="store_true")
+    p.add_argument("--schedule", choices=["gpipe", "1f1b"], default="gpipe",
+                   help="pipeline schedule: GPipe (AD backward) or the "
+                   "memory-bounded interleaved 1F1B (explicit backward)")
     p.add_argument("--dp", type=int, default=None,
                    help="data-parallel ways (inter axis); rest is pipeline")
     args = p.parse_args(argv)
@@ -152,8 +158,47 @@ def main(argv=None):
         head_g = dp_comm.allreduce_grad(head_g)
         return {"embed": embed, "stages": stages, "head": head_g}
 
+    def forward_loss_1f1b(params, batch):
+        # 1F1B: the head rides inside the schedule (loss_params), the
+        # patchify embedding hangs off it via jax.vjp on the input
+        # cotangents — each microbatch's backward starts the tick its
+        # forward ends, bounding live activations to O(pipeline depth).
+        x, y = batch
+        tokens, embed_vjp = jax.vjp(
+            lambda ep: patchify.apply(ep, x), params["embed"]
+        )
+        mine = jax.tree.map(lambda p: jnp.squeeze(p, 0), params["stages"])
+
+        def head_loss(hp, out, tgt):
+            logits = head.apply(hp, out.mean(axis=1))
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, tgt
+            ).mean()
+
+        loss, sg, hg, gtok = pipeline_1f1b_loss_and_grads(
+            stage.apply, head_loss, mine, tokens, y, "intra",
+            args.microbatches, loss_params=params["head"],
+            with_input_grads=True,
+        )
+        gtok = jax.lax.psum(gtok, "intra")   # stage-0 owner
+        hg = jax.lax.psum(hg, "intra")       # last-stage owner
+        (eg,) = embed_vjp(gtok)
+        sg = jax.tree.map(lambda a: jnp.expand_dims(a, 0), sg)
+        return loss, {"embed": eg, "stages": sg, "head": hg}
+
     def step(params, opt_state, prev_grads, step_idx, batch):
         def body(params, prev_grads, batch):
+            if args.schedule == "1f1b":
+                loss, grads = forward_loss_1f1b(params, batch)
+                loss = jax.lax.pmean(loss, "inter")
+                # embed/head grads are already psum-collected over the
+                # pipeline axis inside forward_loss_1f1b; DP-mean the rest.
+                grads = {
+                    "embed": dp_comm.allreduce_grad(grads["embed"]),
+                    "stages": dp_comm.allreduce_grad(grads["stages"]),
+                    "head": dp_comm.allreduce_grad(grads["head"]),
+                }
+                return loss, grads
             loss, grads = jax.value_and_grad(forward_loss)(params, batch)
             loss = jax.lax.pmean(loss, comm.axes)
             grads = reduce_grads(grads)

@@ -84,3 +84,14 @@ def test_vit_pipeline_smoke():
         "--d-ff", "64", "--layers-per-stage", "1", "--n-classes", "10",
         "--microbatches", "2", "--train-size", "16",
     )
+
+
+@pytest.mark.slow
+def test_vit_pipeline_1f1b_smoke():
+    _run(
+        "vit/train_vit.py",
+        "--epochs", "1", "--batchsize", "8", "--image-size", "32",
+        "--patch", "8", "--d-model", "32", "--n-heads", "2",
+        "--d-ff", "64", "--layers-per-stage", "1", "--n-classes", "10",
+        "--microbatches", "2", "--train-size", "16", "--schedule", "1f1b",
+    )
