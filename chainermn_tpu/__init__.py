@@ -46,6 +46,7 @@ def __getattr__(name):
     if name in (
         "create_multi_node_iterator",
         "create_synchronized_iterator",
+        "create_prefetch_iterator",
     ):
         from chainermn_tpu import iterators
 

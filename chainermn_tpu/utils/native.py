@@ -119,7 +119,11 @@ def parallel_gather(items: Sequence[np.ndarray], n_threads: int = 0) -> np.ndarr
 
 class NativeQueue:
     """Bounded byte-buffer queue backed by the C++ ring queue (threading.Queue
-    fallback) — the staging structure under the prefetch iterator."""
+    fallback) — a host-side staging structure for byte-level pipelines (raw
+    record readers, serialized checkpoint chunks).  Note
+    ``iterators.create_prefetch_iterator`` stages ``jax.Array`` batches
+    through a plain ``queue.Queue`` with its own stop-event shutdown; this
+    class is for payloads that live as bytes on the host side."""
 
     def __init__(self, capacity: int = 4):
         self._lib = get_lib()

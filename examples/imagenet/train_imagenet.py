@@ -48,6 +48,9 @@ def main(argv=None):
     p.add_argument("--val-size", type=int, default=512)
     p.add_argument("--steps", type=int, default=None, help="cap steps/epoch")
     p.add_argument("--data-npz", default=None)
+    p.add_argument("--prefetch", type=int, default=2,
+                   help="device-prefetch queue depth (0 disables) — the "
+                   "reference's MultiprocessIterator overlap")
     args = p.parse_args(argv)
 
     comm = chainermn_tpu.create_communicator(args.communicator)
@@ -134,10 +137,21 @@ def main(argv=None):
 
     evaluator = Evaluator(metric_fn, comm)
 
+    def host_batches(epoch):
+        # Host-side work (cast/augment) runs here — inside the prefetch
+        # thread when enabled, overlapped with device compute.
+        for batch in batch_iterator(train, args.batchsize, seed=epoch):
+            yield (batch[0].astype(np.float32), batch[1])
+
     for epoch in range(args.epochs):
         t0, n_seen, last_loss, n_steps = time.perf_counter(), 0, float("nan"), 0
-        for batch in batch_iterator(train, args.batchsize, seed=epoch):
-            x = batch[0].astype(np.float32)
+        batches = host_batches(epoch)
+        if args.prefetch > 0:
+            batches = chainermn_tpu.create_prefetch_iterator(
+                batches, size=args.prefetch
+            )
+        for batch in batches:
+            x = batch[0]
             params, state, batch_stats, loss = step(
                 params, state, batch_stats, (x, batch[1])
             )

@@ -135,6 +135,11 @@ def main(argv=None):
     opt_state = opt.init(params)
     double_buffering = not args.no_double_buffering
 
+    def head_loss(hp, out, tgt):
+        # Shared by both schedules — edit the head/loss here only.
+        logits = head.apply(hp, out.mean(axis=1))
+        return optax.softmax_cross_entropy_with_integer_labels(logits, tgt).mean()
+
     def forward_loss(params, batch):
         x, y = batch
         tokens = patchify.apply(params["embed"], x)
@@ -145,8 +150,7 @@ def main(argv=None):
         # Pipeline output is valid on the last pipeline rank; broadcast it
         # along 'intra' so the (replicated) head computes the loss everywhere.
         out = jax.lax.psum(out, "intra")
-        logits = head.apply(params["head"], out.mean(axis=1))
-        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        return head_loss(params["head"], out, y)
 
     def reduce_grads(grads):
         # Stage grads: DP-mean only. Embed/head grads: collect over the
@@ -168,13 +172,6 @@ def main(argv=None):
             lambda ep: patchify.apply(ep, x), params["embed"]
         )
         mine = jax.tree.map(lambda p: jnp.squeeze(p, 0), params["stages"])
-
-        def head_loss(hp, out, tgt):
-            logits = head.apply(hp, out.mean(axis=1))
-            return optax.softmax_cross_entropy_with_integer_labels(
-                logits, tgt
-            ).mean()
-
         loss, sg, hg, gtok = pipeline_1f1b_loss_and_grads(
             stage.apply, head_loss, mine, tokens, y, "intra",
             args.microbatches, loss_params=params["head"],

@@ -51,3 +51,117 @@ def test_global_except_hook_install_remove():
     assert _sys.excepthook is h._handle_uncaught
     h.remove_hook()
     assert _sys.excepthook is _sys.__excepthook__
+
+
+# ---------------------------------------------------------------------------
+# create_prefetch_iterator (reference: MultiprocessIterator overlap)
+# ---------------------------------------------------------------------------
+
+
+def test_prefetch_preserves_order_and_content():
+    import jax
+    import numpy as np
+
+    from chainermn_tpu.iterators import create_prefetch_iterator
+
+    batches = [
+        (np.full((4, 3), i, np.float32), np.full((4,), i, np.int32))
+        for i in range(10)
+    ]
+    out = list(create_prefetch_iterator(iter(batches), size=3))
+    assert len(out) == 10
+    for i, (x, y) in enumerate(out):
+        assert isinstance(x, jax.Array)  # staged onto device
+        np.testing.assert_array_equal(np.asarray(x), batches[i][0])
+        np.testing.assert_array_equal(np.asarray(y), batches[i][1])
+
+
+def test_prefetch_overlaps_producer_work():
+    """The producer thread must run ahead of the consumer: a slow consumer
+    should find later batches already produced (queue non-empty)."""
+    import time as _time
+
+    import numpy as np
+
+    from chainermn_tpu.iterators import create_prefetch_iterator
+
+    produced = []
+
+    def gen():
+        for i in range(5):
+            produced.append(i)
+            yield np.full((2,), i, np.float32)
+
+    it = create_prefetch_iterator(gen(), size=4)
+    first = next(it)
+    _time.sleep(0.5)  # consumer stalls; producer should have run ahead
+    assert len(produced) >= 4
+    rest = list(it)
+    assert len(rest) == 4
+    np.testing.assert_array_equal(np.asarray(first), np.zeros((2,)))
+
+
+def test_prefetch_propagates_producer_exception():
+    import numpy as np
+    import pytest as _pytest
+
+    from chainermn_tpu.iterators import create_prefetch_iterator
+
+    def gen():
+        yield np.zeros((2,), np.float32)
+        raise RuntimeError("producer exploded")
+
+    it = create_prefetch_iterator(gen(), size=2)
+    next(it)
+    with _pytest.raises(RuntimeError, match="producer exploded"):
+        next(it)
+
+
+def test_prefetch_with_sharding():
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from chainermn_tpu.communicators import build_mesh
+    from chainermn_tpu.iterators import create_prefetch_iterator
+
+    mesh = build_mesh()
+    sh = NamedSharding(mesh, P(("inter", "intra")))
+    n = len(jax.devices())
+    batches = [np.arange(n * 2, dtype=np.float32).reshape(n * 2, 1)]
+    (out,) = list(create_prefetch_iterator(iter(batches), size=1, sharding=sh))
+    assert out.sharding == sh
+
+
+def test_prefetch_rejects_bad_size():
+    import pytest as _pytest
+
+    from chainermn_tpu.iterators import create_prefetch_iterator
+
+    with _pytest.raises(ValueError, match="size"):
+        create_prefetch_iterator(iter([]), size=0)
+
+
+def test_prefetch_shutdown_on_abandon():
+    """Breaking out of the consuming loop must stop the producer thread and
+    drain queued batches (no leaked thread spinning in q.put)."""
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from chainermn_tpu.iterators import create_prefetch_iterator
+
+    n_before = threading.active_count()
+
+    def gen():
+        for i in range(100):
+            yield np.full((2,), i, np.float32)
+
+    it = create_prefetch_iterator(gen(), size=2)
+    next(it)
+    it.close()  # what GC of an abandoned iterator does
+    deadline = _time.time() + 5
+    while threading.active_count() > n_before and _time.time() < deadline:
+        _time.sleep(0.05)
+    assert threading.active_count() <= n_before
