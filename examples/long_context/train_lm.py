@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Long-context causal language-model training — the net-new capability the
+reference never had (SURVEY §5.7: sequence parallelism ABSENT upstream).
+
+Composable long-context stack, selectable per flag:
+
+* ``--sp none``  + flash attention: one chip holds the whole sequence; the
+  Pallas flash kernel (ops.flash_attention) streams KV blocks through VMEM
+  with online softmax — O(S) memory, ~18x faster than materialized-logits
+  attention at S=8192/bf16 on a v5e-class chip.
+* ``--sp ring``: the sequence dimension is sharded over the mesh's
+  ``intra`` axis; K/V blocks rotate between chips via ``lax.ppermute``
+  (parallel.ring_attention) with the same online-softmax accumulation —
+  context length scales with the number of chips.
+* ``--sp ulysses``: all-to-all swaps the sharded dimension seq<->heads
+  around a local full attention (parallel.ulysses).
+
+Mesh layout: ``inter`` = data parallel, ``intra`` = sequence parallel.
+Each batch element's tokens are split into ``intra`` contiguous shards;
+``position_offset`` keeps rotary/sinusoidal positions globally correct.
+
+Training signal: synthetic successor sequences (next token = current + 1
+mod vocab, random start), so the LM's loss collapses quickly — a
+correctness canary, not a benchmark.
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu.models.transformer import TransformerLM
+from chainermn_tpu.ops import make_flash_attention_fn
+from chainermn_tpu.parallel.ring_attention import make_ring_attention_fn
+from chainermn_tpu.parallel.ulysses import make_ulysses_attention_fn
+from chainermn_tpu.utils.profiling import sync
+
+
+def successor_batch(rng, batch, seq_len, vocab):
+    start = rng.randint(0, vocab, size=(batch, 1))
+    seq = (start + np.arange(seq_len)[None, :]) % vocab
+    return seq.astype(np.int32)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--batchsize", type=int, default=8, help="global batch")
+    p.add_argument("--d-model", type=int, default=256)
+    p.add_argument("--n-heads", type=int, default=8)
+    p.add_argument("--d-ff", type=int, default=1024)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--vocab", type=int, default=512)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--steps-per-epoch", type=int, default=20)
+    p.add_argument("--sp", choices=["none", "ring", "ulysses"], default="none",
+                   help="sequence parallelism over the 'intra' mesh axis")
+    p.add_argument("--no-flash", action="store_true",
+                   help="disable the Pallas flash kernel (sp=none only)")
+    p.add_argument("--dtype", choices=["float32", "bfloat16"],
+                   default="bfloat16")
+    p.add_argument("--dp", type=int, default=None,
+                   help="data-parallel ways (inter axis); rest is sequence")
+    args = p.parse_args(argv)
+
+    comm = chainermn_tpu.create_communicator("xla_ici", inter_size=args.dp)
+    dp, sp_ways = comm.inter_size, comm.intra_size
+    S, B, vocab = args.seq_len, args.batchsize, args.vocab
+    dtype = jnp.dtype(args.dtype)
+
+    if args.sp == "none":
+        attention_fn = None if args.no_flash else make_flash_attention_fn()
+        sp_ways_eff = 1
+    elif args.sp == "ring":
+        attention_fn = make_ring_attention_fn("intra")
+        sp_ways_eff = sp_ways
+    else:
+        attention_fn = make_ulysses_attention_fn("intra")
+        sp_ways_eff = sp_ways
+    if args.sp != "none" and sp_ways == 1:
+        raise SystemExit(
+            "sequence parallelism needs intra_size > 1; pass --dp to leave "
+            "devices on the intra axis (e.g. --dp 1)"
+        )
+    if S % max(sp_ways_eff, 1):
+        raise SystemExit(f"--seq-len {S} must divide by sp ways {sp_ways_eff}")
+    if args.sp != "none" and args.n_heads % sp_ways:
+        raise SystemExit("ulysses/ring need n_heads % sp ways == 0")
+
+    model = TransformerLM(
+        vocab=vocab, d_model=args.d_model, n_heads=args.n_heads,
+        d_ff=args.d_ff, n_layers=args.layers, max_len=S, dtype=dtype,
+        attention_fn=attention_fn,
+    )
+    S_local = S // max(sp_ways_eff, 1)
+    tok0 = jnp.zeros((1, S_local), jnp.int32)
+    # Init with a dense twin: parameters don't depend on attention_fn, and
+    # the ring/ulysses fns need their mesh axis bound (shard_map) to trace.
+    init_model = TransformerLM(
+        vocab=vocab, d_model=args.d_model, n_heads=args.n_heads,
+        d_ff=args.d_ff, n_layers=args.layers, max_len=S, dtype=dtype,
+        attention_fn=None,
+    )
+    params = init_model.init(jax.random.PRNGKey(0), tok0)
+    params = jax.tree.map(lambda x: x.astype(jnp.float32), params)
+
+    opt = optax.adamw(args.lr, weight_decay=0.01)
+    opt_state = opt.init(params)
+    if comm.rank == 0:
+        n_params = sum(l.size for l in jax.tree.leaves(params))
+        print(f"mesh: data={dp} x seq={sp_ways}; sp={args.sp} "
+              f"flash={args.sp == 'none' and not args.no_flash} "
+              f"params={n_params/1e6:.1f}M seq_len={S}")
+
+    denom = B * (S - 1)  # global count of predicted positions
+
+    if args.sp == "none":
+        # Pure DP path through the reference-shaped optimizer wrapper.
+        mn_opt = chainermn_tpu.create_multi_node_optimizer(opt, comm)
+
+        def loss_fn(params, batch):
+            tok, tgt, wt = batch
+            logits = model.apply(params, tok)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, tgt)
+            # Local mean over this device's (equal-size) share of the
+            # predicted positions; the wrapper pmeans across devices.
+            return jnp.sum(ce * wt) / (denom / comm.device_size)
+
+        dp_step = mn_opt.make_train_step(loss_fn, donate=False)
+
+        def step(carry, batch):
+            params, st = carry
+            params, st, loss = dp_step(params, st, batch)
+            return (params, st), loss
+
+        carry = (params, mn_opt.init(params))
+    else:
+        def body(params, opt_state, tok_l, tgt_l, wt_l):
+            def loss_fn(params):
+                offset = lax.axis_index("intra") * S_local
+                logits = model.apply(params, tok_l, position_offset=offset)
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, tgt_l
+                )
+                # Sum here, global mean via psum: shards hold different
+                # numbers of unmasked positions (the last shard masks the
+                # final token), so a plain pmean-of-means would be biased.
+                return jnp.sum(ce * wt_l) / denom
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            loss = lax.psum(loss, comm.axes)
+            grads = jax.tree.map(lambda g: lax.psum(g, comm.axes), grads)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        batch_spec = P("inter", "intra")
+        mapped = comm.shard_map(
+            body,
+            in_specs=(P(), P(), batch_spec, batch_spec, batch_spec),
+            out_specs=(P(), P(), P()),
+        )
+        jitted = jax.jit(mapped)
+
+        def step(carry, batch):
+            params, opt_state = carry
+            params, opt_state, loss = jitted(params, opt_state, *batch)
+            return (params, opt_state), loss
+
+        carry = (params, opt_state)
+
+    rng = np.random.RandomState(0)
+    wt_np = np.ones((B, S), np.float32)
+    wt_np[:, -1] = 0.0  # final position has no successor
+    wt = jnp.asarray(wt_np)
+
+    last = float("nan")
+    for epoch in range(args.epochs):
+        t0, n_tok = time.perf_counter(), 0
+        for _ in range(args.steps_per_epoch):
+            tok_np = successor_batch(rng, B, S, vocab)
+            tok = jnp.asarray(tok_np)
+            tgt = jnp.asarray(np.roll(tok_np, -1, axis=1))
+            carry, last = step(carry, (tok, tgt, wt))
+            n_tok += B * S
+        sync(last)  # host readback: honest timing on all backends
+        dt = time.perf_counter() - t0
+        if comm.rank == 0:
+            print(
+                f"epoch {epoch}: loss {float(last):.4f} "
+                f"({n_tok / dt:,.0f} tok/s)"
+            )
+    return float(last)
+
+
+if __name__ == "__main__":
+    main()

@@ -95,3 +95,17 @@ def test_vit_pipeline_1f1b_smoke():
         "--d-ff", "64", "--layers-per-stage", "1", "--n-classes", "10",
         "--microbatches", "2", "--train-size", "16", "--schedule", "1f1b",
     )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("sp", ["none", "ring", "ulysses"])
+def test_long_context_lm_smoke(sp):
+    # sp=none is pure DP: the global batch must divide the 8-device world.
+    extra = [] if sp == "none" else ["--dp", "2"]
+    _run(
+        "long_context/train_lm.py",
+        "--sp", sp, "--seq-len", "256", "--batchsize", "8",
+        "--d-model", "32", "--n-heads", "4", "--d-ff", "64",
+        "--layers", "1", "--vocab", "64", "--epochs", "1",
+        "--steps-per-epoch", "4", "--dtype", "float32", *extra,
+    )
