@@ -23,33 +23,83 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def top1_route(gate_logits: jax.Array, n_experts: int, capacity: int):
-    """Top-1 routing with per-(device, expert) capacity.
+def topk_route(gate_logits: jax.Array, n_experts: int, capacity: int,
+               k: int = 1):
+    """Top-k routing with per-(device, expert) capacity (GShard-style).
 
     gate_logits: (T, E).  Returns (dispatch, combine):
       dispatch: (E, C, T) one-hot dispatch mask (token t fills slot c of
                 expert e), zeros for dropped/padded slots;
-      combine:  (E, C, T) dispatch × gate probability (the weight used when
+      combine:  (E, C, T) dispatch × gate weight (the weight used when
                 summing expert outputs back per token).
+
+    For ``k > 1`` each token goes to its k highest-probability experts with
+    gates renormalized over the chosen set; first choices claim capacity
+    slots before second choices (choice-major priority, as in GShard).
     """
     T, E = gate_logits.shape
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
-    expert = jnp.argmax(probs, axis=-1)                     # (T,)
-    gate = jnp.max(probs, axis=-1)                          # (T,)
 
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)   # (T, E)
-    # Position of each token within its expert's queue.
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0         # (T, E), -1 elsewhere
-    kept = (pos >= 0) & (pos < capacity)
+    onehots, gates = [], []
+    remaining = probs
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)
+        oh = jax.nn.one_hot(idx, E, dtype=jnp.float32)       # (T, E)
+        gate = jnp.sum(remaining * oh, axis=-1)              # raw prob
+        # Degenerate choice guard: if the remaining mass is exactly zero
+        # (softmax collapsed onto earlier choices), argmax returns index 0
+        # spuriously — drop the choice instead of burning a capacity slot.
+        oh = oh * (gate > 0)[:, None]
+        gates.append(gate)
+        onehots.append(oh)
+        remaining = remaining * (1.0 - oh)
+    if k > 1:
+        # GShard renormalizes over the chosen set; for k=1 the Switch
+        # combine weight IS the router probability (renormalizing would
+        # pin it to ~1 and starve the router of main-loss gradient).
+        denom = sum(gates) + 1e-9
+        gates = [g / denom for g in gates]
 
-    slot = jnp.where(kept, pos, 0).astype(jnp.int32)        # (T, E)
-    slot_onehot = (
-        jax.nn.one_hot(slot, capacity, dtype=jnp.float32) * kept[..., None]
-    )                                                       # (T, E, C)
-    # dispatch[e, c, t] = 1 if token t sits in slot c of expert e.
-    dispatch = jnp.einsum("te,tec->ect", onehot, slot_onehot)
-    combine = dispatch * gate[None, None, :]
+    dispatch = jnp.zeros((E, capacity, T), jnp.float32)
+    combine = jnp.zeros((E, capacity, T), jnp.float32)
+    claimed = jnp.zeros((E,), jnp.float32)   # slots used by earlier choices
+    for oh, gate in zip(onehots, gates):
+        # Position within the expert queue: within-choice arrival order,
+        # offset by slots earlier choices already claimed.
+        pos = (jnp.cumsum(oh, axis=0) - 1.0 + claimed[None, :]) * oh
+        pos = pos - (1.0 - oh)                               # -1 off-expert
+        kept = (pos >= 0) & (pos < capacity)
+        slot = jnp.where(kept, pos, 0).astype(jnp.int32)     # (T, E)
+        slot_onehot = (
+            jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
+            * kept[..., None]
+        )                                                    # (T, E, C)
+        d = jnp.einsum("te,tec->ect", oh, slot_onehot)
+        dispatch = dispatch + d
+        combine = combine + d * gate[None, None, :]
+        claimed = claimed + jnp.sum(oh, axis=0)
     return dispatch, combine
+
+
+def top1_route(gate_logits: jax.Array, n_experts: int, capacity: int):
+    """Top-1 routing (Switch-style) — see :func:`topk_route`."""
+    return topk_route(gate_logits, n_experts, capacity, k=1)
+
+
+def load_balancing_loss(gate_logits: jax.Array, n_experts: int):
+    """Switch-Transformer auxiliary load-balancing loss.
+
+    ``E * Σ_e f_e · P_e`` where ``f_e`` is the fraction of tokens whose
+    top-1 expert is ``e`` and ``P_e`` the mean router probability of ``e``;
+    equals 1.0 under perfectly uniform routing, grows as routing collapses.
+    Add ``aux_weight * load_balancing_loss(...)`` (typical weight 1e-2) to
+    the training loss.
+    """
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    top1 = jax.nn.one_hot(jnp.argmax(probs, -1), n_experts, dtype=jnp.float32)
+    f = jnp.mean(top1, axis=0)
+    P = jnp.mean(probs, axis=0)
+    return n_experts * jnp.sum(f * P)
 
 
 def moe_layer(
@@ -59,6 +109,8 @@ def moe_layer(
     expert_params,
     axis_name: str,
     capacity_factor: float = 2.0,
+    k: int = 1,
+    return_aux: bool = False,
 ):
     """Expert-parallel MoE FFN; call inside ``shard_map`` over ``axis_name``.
 
@@ -66,16 +118,19 @@ def moe_layer(
     weights (replicated).  ``expert_params``: THIS device's expert's
     parameters (one expert per device; E = axis size).
     ``expert_fn(params, tokens) -> tokens`` is the expert computation.
+    ``k``: experts per token (1 = Switch, 2 = GShard top-2).
+    ``return_aux``: also return the Switch load-balancing loss for this
+    device's tokens (add to the training loss, typical weight 1e-2).
 
     Returns (T_local, D) with each token replaced by its expert's output
     weighted by the gate (dropped-by-capacity tokens pass through as zeros,
     as in Switch)."""
     E = lax.axis_size(axis_name)
     T, D = x.shape
-    capacity = max(1, int(capacity_factor * T / E))
+    capacity = max(1, int(capacity_factor * k * T / E))
 
     gate_logits = x @ gate_w                                # (T, E)
-    dispatch, combine = top1_route(gate_logits, E, capacity)
+    dispatch, combine = topk_route(gate_logits, E, capacity, k=k)
 
     # Gather each expert's slots from local tokens: (E, C, D).
     expert_in = jnp.einsum("ect,td->ecd", dispatch, x.astype(jnp.float32))
@@ -89,15 +144,19 @@ def moe_layer(
     # Route back: leading axis returns to expert-major layout per source.
     out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0, tiled=True)
     # Combine: token t = sum over (e, c) of combine[e,c,t] * out[e,c,:].
-    return jnp.einsum("ect,ecd->td", combine, out).astype(x.dtype)
+    y = jnp.einsum("ect,ecd->td", combine, out).astype(x.dtype)
+    if return_aux:
+        return y, load_balancing_loss(gate_logits, E)
+    return y
 
 
-def dense_moe_oracle(x, gate_w, expert_fn, all_expert_params, capacity_factor=2.0):
+def dense_moe_oracle(x, gate_w, expert_fn, all_expert_params,
+                     capacity_factor=2.0, k=1):
     """Single-device oracle: same routing math with all experts local."""
     E = gate_w.shape[1]
     T, D = x.shape
-    capacity = max(1, int(capacity_factor * T / E))
-    dispatch, combine = top1_route(x @ gate_w, E, capacity)
+    capacity = max(1, int(capacity_factor * k * T / E))
+    dispatch, combine = topk_route(x @ gate_w, E, capacity, k=k)
     expert_in = jnp.einsum("ect,td->ecd", dispatch, x.astype(jnp.float32))
     outs = []
     for e in range(E):
