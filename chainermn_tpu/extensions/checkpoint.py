@@ -9,10 +9,20 @@ restores the latest consistent set before resuming training (SURVEY §5.4).
 TPU-native shape: one snapshot file per *process* (host), holding that
 host's addressable shards of the state pytree — the sharded-checkpoint
 layout orbax standardized, implemented in-repo to keep the framework
-self-contained.  Consistency is a two-phase commit in miniature: write to a
-temp name, atomic rename, then a marker file per generation; ``maybe_load``
-only accepts generations whose marker count equals the world size.  On a
-single host this degrades to plain snapshot/rotate.
+self-contained.  Leaves that span non-addressable devices (multi-host
+GSPMD arrays, ZeRO-3 flat buffers) are saved as their local shard list and
+re-assembled on load against the template's sharding.  Consistency is a
+two-phase commit in miniature: write to a temp name, atomic rename, then a
+marker file per generation; ``maybe_load`` only accepts generations whose
+marker count equals the world size.  On a single host this degrades to
+plain snapshot/rotate.
+
+``save(..., block=False)`` runs serialization and file I/O on a background
+thread (the device→host transfer stays synchronous, so the training loop
+may immediately mutate/donate the live state): checkpoint cost overlaps
+the next training steps, the reference-era pattern of pausing the trainer
+to snapshot is gone.  ``wait()`` joins the in-flight write; ``save`` and
+``maybe_load`` join it implicitly.
 """
 
 from __future__ import annotations
@@ -20,6 +30,7 @@ from __future__ import annotations
 import os
 import pickle
 import re
+import threading
 from typing import Any, Optional, Tuple
 
 import jax
@@ -28,15 +39,72 @@ import numpy as np
 from chainermn_tpu.communicators.base import CommunicatorBase
 
 
+class _ShardList:
+    """Pickled stand-in for a leaf that spans non-addressable devices:
+    this process's addressable shards plus each shard's global index
+    (``Shard.index``), in ``addressable_shards`` order."""
+
+    def __init__(self, shards, indices):
+        self.shards = shards
+        self.indices = indices
+
+
 def _to_host(tree):
-    """Device arrays → numpy (addressable shards only)."""
+    """Device arrays → numpy (this process's addressable data only).
+
+    Non-jax ndarray leaves are copied: the returned tree may be pickled on
+    a background thread (async save) while the caller keeps mutating the
+    live state, so nothing in it may alias caller-owned buffers."""
 
     def conv(x):
         if isinstance(x, jax.Array):
-            return np.asarray(x)
+            if x.is_fully_addressable:
+                return np.asarray(x)
+            return _ShardList(
+                [np.asarray(s.data) for s in x.addressable_shards],
+                [s.index for s in x.addressable_shards],
+            )
+        if isinstance(x, np.ndarray):
+            return np.array(x, copy=True)
         return x
 
     return jax.tree.map(conv, tree)
+
+
+def _restore_leaf(tpl, saved):
+    """Rebuild one leaf from its saved host form against the template leaf
+    (structure, dtype, and — for jax Arrays — sharding/placement)."""
+    if isinstance(saved, _ShardList):
+        if not isinstance(tpl, jax.Array):
+            raise ValueError(
+                "checkpoint leaf was saved as device shards but the "
+                "template leaf is not a jax.Array"
+            )
+        tpl_shards = list(tpl.addressable_shards)
+        if len(tpl_shards) != len(saved.shards):
+            raise ValueError(
+                f"checkpoint shard count ({len(saved.shards)}) does not "
+                f"match template ({len(tpl_shards)}) — was the mesh resized?"
+            )
+        tpl_indices = [s.index for s in tpl_shards]
+        if saved.indices != tpl_indices:
+            raise ValueError(
+                "checkpoint shard layout does not match the template's "
+                f"sharding (saved indices {saved.indices} vs template "
+                f"{tpl_indices}) — restoring would place data at wrong "
+                "global offsets; load with the save-time sharding instead"
+            )
+        arrs = [
+            jax.device_put(np.asarray(d).astype(tpl.dtype), s.device)
+            for d, s in zip(saved.shards, tpl_shards)
+        ]
+        return jax.make_array_from_single_device_arrays(
+            tpl.shape, tpl.sharding, arrs
+        )
+    arr = np.asarray(saved)
+    if isinstance(tpl, jax.Array):
+        return jax.device_put(arr.astype(tpl.dtype), tpl.sharding)
+    return arr.astype(getattr(tpl, "dtype", arr.dtype))
 
 
 class MultiNodeCheckpointer:
@@ -52,6 +120,8 @@ class MultiNodeCheckpointer:
         self.dir = os.path.join(path, name)
         self.keep = keep
         os.makedirs(self.dir, exist_ok=True)
+        self._pending: Optional[threading.Thread] = None
+        self._pending_error: Optional[BaseException] = None
 
     # -- file layout -----------------------------------------------------
     def _snap(self, iteration: int, rank: int) -> str:
@@ -61,16 +131,48 @@ class MultiNodeCheckpointer:
         return os.path.join(self.dir, f"done_iter_{iteration}.rank{rank}")
 
     # -- API (reference: checkpointer.save / maybe_load) ------------------
-    def save(self, state: Any, iteration: int) -> None:
+    def save(self, state: Any, iteration: int, block: bool = True) -> None:
+        """Snapshot ``state`` as generation ``iteration``.
+
+        ``block=False``: the device→host transfer happens now (safe to
+        donate/mutate the live state immediately), but pickling and file
+        I/O run on a background thread — call :meth:`wait` (or let the
+        next ``save``/``maybe_load`` do it) to join.
+        """
+        self.wait()
         rank = self.comm.rank
-        tmp = self._snap(iteration, rank) + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(_to_host(state), f, protocol=pickle.HIGHEST_PROTOCOL)
-        os.replace(tmp, self._snap(iteration, rank))
-        with open(self._marker(iteration, rank), "w") as f:
-            f.write("ok")
-        self.comm.barrier()
-        self._cleanup()
+        host_state = _to_host(state)
+
+        def write():
+            tmp = self._snap(iteration, rank) + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, self._snap(iteration, rank))
+            with open(self._marker(iteration, rank), "w") as f:
+                f.write("ok")
+            self._cleanup()
+
+        if block:
+            write()
+            self.comm.barrier()
+        else:
+            def run():
+                try:
+                    write()
+                except BaseException as e:  # noqa: BLE001 — surfaced in wait()
+                    self._pending_error = e
+
+            self._pending = threading.Thread(target=run, daemon=True)
+            self._pending.start()
+
+    def wait(self) -> None:
+        """Join an in-flight async save; re-raise its error, if any."""
+        t, self._pending = self._pending, None
+        if t is not None:
+            t.join()
+        err, self._pending_error = self._pending_error, None
+        if err is not None:
+            raise err
 
     def _generations(self):
         pat = re.compile(r"done_iter_(\d+)\.rank(\d+)$")
@@ -98,7 +200,13 @@ class MultiNodeCheckpointer:
 
     def maybe_load(self, state: Any = None) -> Tuple[Any, Optional[int]]:
         """Restore the newest consistent generation, or return ``state``
-        untouched when none exists (reference ``maybe_load`` contract)."""
+        untouched when none exists (reference ``maybe_load`` contract).
+
+        With a ``state`` template, every leaf is restored at the
+        template's dtype AND placement: replicated/sharded jax Arrays come
+        back with the template's sharding (shard-list leaves are
+        re-assembled onto the template's addressable devices)."""
+        self.wait()
         done = self._consistent_generations()
         if not done:
             return state, None
@@ -106,13 +214,9 @@ class MultiNodeCheckpointer:
         with open(self._snap(it, self.comm.rank), "rb") as f:
             loaded = pickle.load(f)
         if state is not None:
-            # Preserve the template's structure/dtypes: restore leaf-wise.
             loaded = jax.tree.map(
-                lambda tpl, new: np.asarray(new).astype(
-                    getattr(tpl, "dtype", np.asarray(new).dtype)
-                ),
-                state,
-                loaded,
+                _restore_leaf, state, loaded,
+                is_leaf=lambda x: isinstance(x, _ShardList),
             )
         return loaded, it
 

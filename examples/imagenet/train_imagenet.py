@@ -43,6 +43,9 @@ def main(argv=None):
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--num-classes", type=int, default=1000)
     p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--optimizer", choices=["sgd", "lars"], default="sgd",
+                   help="lars = layer-wise adaptive rates for very large "
+                   "global batches")
     p.add_argument("--warmup-steps", type=int, default=100)
     p.add_argument("--train-size", type=int, default=4096)
     p.add_argument("--val-size", type=int, default=512)
@@ -87,11 +90,15 @@ def main(argv=None):
 
     # Linear-scaling rule with warmup (the reference stack's large-batch
     # recipe): lr = base * (global_batch / 256), warmed up from 0.
+    # --optimizer lars is the layer-wise adaptive-rate variant the
+    # extreme-batch ResNet results (arXiv:1711.04325-era) relied on.
     scaled_lr = args.lr * args.batchsize / 256.0
     sched = optax.linear_schedule(0.0, scaled_lr, args.warmup_steps)
-    opt = chainermn_tpu.create_multi_node_optimizer(
-        optax.sgd(sched, momentum=0.9, nesterov=False), comm
-    )
+    if args.optimizer == "lars":
+        inner = optax.lars(sched, momentum=0.9, weight_decay=1e-4)
+    else:
+        inner = optax.sgd(sched, momentum=0.9, nesterov=False)
+    opt = chainermn_tpu.create_multi_node_optimizer(inner, comm)
     state = opt.init(params)
 
     if has_bn:

@@ -22,10 +22,12 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_two_process_object_plane():
+def test_two_process_object_plane(tmp_path):
     port = _free_port()
     nproc = 2
     env = subprocess_env(n_devices=1)
+    # Shared dir for the multi-host checkpointer round-trip in the worker.
+    env["CHAINERMN_TPU_TEST_CKPT_DIR"] = str(tmp_path)
 
     procs = [
         subprocess.Popen(

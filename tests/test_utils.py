@@ -75,3 +75,55 @@ def test_step_timer():
             pass
     assert t.mean_s >= 0.0
     assert t.throughput(10) > 0
+
+
+# ---------------------------------------------------------------------------
+# corpus BLEU (reference seq2seq reported BLEU; in-repo implementation)
+# ---------------------------------------------------------------------------
+
+
+def test_bleu_perfect_match_is_one():
+    from chainermn_tpu.utils.metrics import corpus_bleu
+
+    seqs = [[1, 2, 3, 4, 5], [6, 7, 8, 9]]
+    assert abs(corpus_bleu(seqs, seqs, smooth=False) - 1.0) < 1e-9
+
+
+def test_bleu_disjoint_is_zero():
+    from chainermn_tpu.utils.metrics import corpus_bleu
+
+    assert corpus_bleu([[1, 2, 3, 4]], [[5, 6, 7, 8]]) == 0.0
+
+
+def test_bleu_known_value():
+    """Hand-checked: hyp shares 3/4 unigrams, 2/3 bigrams, 1/2 trigrams,
+    0+1/1+1 smoothed 4-grams with the reference; lengths equal (BP=1)."""
+    from chainermn_tpu.utils.metrics import corpus_bleu
+
+    ref = [[1, 2, 3, 4]]
+    hyp = [[1, 2, 3, 9]]
+    import math
+
+    expect = math.exp(
+        (math.log(3 / 4) + math.log((2 + 1) / (3 + 1))
+         + math.log((1 + 1) / (2 + 1)) + math.log((0 + 1) / (1 + 1))) / 4
+    )
+    got = corpus_bleu(ref, hyp, smooth=True)
+    assert abs(got - expect) < 1e-9
+
+
+def test_bleu_brevity_penalty():
+    from chainermn_tpu.utils.metrics import corpus_bleu
+
+    ref = [[1, 2, 3, 4, 5, 6, 7, 8]]
+    short = [[1, 2, 3, 4]]
+    full = corpus_bleu(ref, ref, smooth=False)
+    clipped = corpus_bleu(ref, short, smooth=True)
+    assert clipped < full  # BP punishes the short hypothesis
+
+
+def test_strip_special():
+    from chainermn_tpu.utils.metrics import strip_special
+
+    assert strip_special([5, 6, 2, 9, 9]) == [5, 6]      # cut at EOS
+    assert strip_special([0, 5, 0, 6]) == [5, 6]         # drop PAD
