@@ -172,7 +172,9 @@ class TransformerLM(nn.Module):
         """``position_offset``: global position of this shard's first token —
         pass ``axis_index * S_local`` when the sequence dimension is sharded
         (sequence parallelism); requires a sequence-aware ``attention_fn``
-        (ring/Ulysses), since the dense path's causal mask is local."""
+        (ring/Ulysses), since the dense path's causal mask is local.
+        Alternatively a ``(S_local,)`` int array of explicit global
+        positions, for non-contiguous shard layouts (zigzag ring)."""
         import jax.lax as _lax
 
         embed = nn.Embed(self.vocab, self.d_model, dtype=self.dtype, name="embed")
@@ -180,6 +182,8 @@ class TransformerLM(nn.Module):
         S = tokens.shape[1]
         if position_offset is None:
             pos = pe[:S]
+        elif getattr(position_offset, "ndim", 0):
+            pos = pe[position_offset]      # explicit per-token positions
         else:
             pos = _lax.dynamic_slice_in_dim(pe, position_offset, S, axis=0)
         x = embed(tokens) + pos[None].astype(self.dtype)

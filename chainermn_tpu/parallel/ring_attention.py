@@ -50,6 +50,32 @@ def _block_attn(q, k, v, mask, scale):
     return m, l, pv
 
 
+def _online_merge(stats, blk, gate=None):
+    """Merge one block's (m, l, pv) into running online-softmax stats.
+
+    NaN-safe at the -inf edges (fully-masked rows, untouched accumulators):
+    the ``isfinite`` guards zero the dead branch instead of producing
+    ``exp(-inf - -inf)``.  ``gate`` (bool) drops the block entirely when
+    False — used by the zigzag schedule's data-selected blocks.
+    """
+    m_run, l_run, acc = stats
+    m_blk, l_blk, pv_blk = blk
+    if gate is not None:
+        m_blk = jnp.where(gate, m_blk, -jnp.inf)
+        l_blk = jnp.where(gate, l_blk, 0.0)
+        pv_blk = jnp.where(gate, pv_blk, 0.0)
+    m_new = jnp.maximum(m_run, m_blk)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    alpha = jnp.where(jnp.isfinite(m_run), jnp.exp(m_run - m_safe), 0.0)
+    beta = jnp.where(jnp.isfinite(m_blk), jnp.exp(m_blk - m_safe), 0.0)
+    l_new = l_run * alpha + l_blk * beta
+    acc_new = (
+        acc * alpha.transpose(0, 2, 1)[..., None]
+        + pv_blk * beta.transpose(0, 2, 1)[..., None]
+    )
+    return (m_new, l_new, acc_new)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -84,18 +110,8 @@ def ring_attention(
             mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
         else:
             mask = None
-        m_blk, l_blk, pv_blk = _block_attn(q, k_blk, v_blk, mask, scale)
-
-        # Online softmax merge.
-        m_new = jnp.maximum(m_run, m_blk)
-        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        alpha = jnp.where(jnp.isfinite(m_run), jnp.exp(m_run - m_safe), 0.0)
-        beta = jnp.where(jnp.isfinite(m_blk), jnp.exp(m_blk - m_safe), 0.0)
-        l_new = l_run * alpha + l_blk * beta
-        acc_new = (
-            acc * alpha.transpose(0, 2, 1)[..., None]
-            + pv_blk * beta.transpose(0, 2, 1)[..., None]
-        )
+        blk = _block_attn(q, k_blk, v_blk, mask, scale)
+        m_new, l_new, acc_new = _online_merge((m_run, l_run, acc), blk)
 
         # Rotate K/V to the next chip (skipped after the last block's use
         # would be wasted, but a uniform scan keeps the program static).
@@ -115,6 +131,118 @@ def ring_attention(
     return (acc / denom).astype(q.dtype)
 
 
+def zigzag_indices(seq_len: int, n_shards: int):
+    """Permutation putting a global sequence into zigzag layout.
+
+    The sequence is cut into ``2n`` chunks; shard ``r`` holds chunks
+    ``(r, 2n-1-r)`` — one early, one late.  Under causal attention this
+    balances work perfectly: plain contiguous sharding gives shard ``r``
+    ``r+1`` live block-pairs (the last shard does ``n`` while the first
+    idles); zigzag gives every shard exactly 2 live half-block pairs per
+    ring step.  Apply to the sequence axis BEFORE sharding
+    (``x[:, zigzag_indices(S, n)]``), and :func:`inverse_zigzag_indices`
+    to outputs.
+    """
+    import numpy as np
+
+    if seq_len % (2 * n_shards):
+        raise ValueError(f"seq_len {seq_len} must divide by 2*{n_shards}")
+    c = seq_len // (2 * n_shards)
+    idx = []
+    for r in range(n_shards):
+        idx.extend(range(r * c, (r + 1) * c))
+        idx.extend(range((2 * n_shards - 1 - r) * c, (2 * n_shards - r) * c))
+    return np.asarray(idx)
+
+
+def inverse_zigzag_indices(seq_len: int, n_shards: int):
+    import numpy as np
+
+    idx = zigzag_indices(seq_len, n_shards)
+    inv = np.empty_like(idx)
+    inv[idx] = np.arange(seq_len)
+    return inv
+
+
+def zigzag_ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    scale: Optional[float] = None,
+):
+    """Causal ring attention over zigzag-sharded sequences — half the FLOPs
+    of :func:`ring_attention` at perfect load balance.
+
+    Inputs are this chip's zigzag shard (see :func:`zigzag_indices`):
+    ``(B, S_local, H, D)`` where the first half is chunk ``r`` (early) and
+    the second half chunk ``2n-1-r`` (late).  Per ring step each chip runs
+    exactly TWO half-chunk block attentions (plain causal ring attention
+    computes the full masked S_local² block every step, half of it dead):
+
+    * its late chunk attends the received early chunk (always live);
+    * its early chunk attends the received early chunk when the source is
+      behind it, OTHERWISE its late chunk attends the received late chunk
+      — exactly one of the two is causally live, selected by data, so the
+      program stays uniform while no chip computes a dead block.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    if S % 2:
+        raise ValueError("zigzag shard length must be even (two chunks)")
+    C = S // 2
+    if scale is None:
+        scale = 1.0 / (D**0.5)
+
+    qa, qb = q[:, :C], q[:, C:]          # chunk ids: a = my, b = 2n-1-my
+    tri = jnp.tril(jnp.ones((C, C), bool))[None, None]
+
+    def zeros_stats():
+        return (
+            jnp.full((B, H, C), -jnp.inf, jnp.float32),
+            jnp.zeros((B, H, C), jnp.float32),
+            jnp.zeros((B, C, H, D), jnp.float32),
+        )
+
+    # j = 0: own block — both diagonals triangular, late-attends-early full.
+    sa = _online_merge(zeros_stats(), _block_attn(qa, k[:, :C], v[:, :C], tri, scale))
+    sb = _online_merge(zeros_stats(), _block_attn(qb, k[:, :C], v[:, :C], None, scale))
+    sb = _online_merge(sb, _block_attn(qb, k[:, C:], v[:, C:], tri, scale))
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(carry, j):
+        k_blk, v_blk, sa, sb = carry
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        # After j rotations the block originates at rank (my - j) mod n.
+        early_live = my >= j           # src strictly behind: a·ka live
+        # One conditional half-block: a·ka when early_live, else b·kb.
+        q_in = jnp.where(early_live, qa, qb)
+        k_in = jnp.where(early_live, k_blk[:, :C], k_blk[:, C:])
+        v_in = jnp.where(early_live, v_blk[:, :C], v_blk[:, C:])
+        blk2 = _block_attn(q_in, k_in, v_in, None, scale)
+        sa = _online_merge(sa, blk2, gate=early_live)
+        sb = _online_merge(sb, blk2, gate=jnp.logical_not(early_live))
+        # Late chunk b always attends the received early chunk ka.
+        sb = _online_merge(
+            sb, _block_attn(qb, k_blk[:, :C], v_blk[:, :C], None, scale)
+        )
+        return (k_blk, v_blk, sa, sb), None
+
+    (_, _, sa, sb), _ = lax.scan(
+        jax.checkpoint(body), (k, v, sa, sb), jnp.arange(1, n)
+    )
+
+    def finish(stats):
+        m, l, acc = stats
+        denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+        return (acc / denom).astype(q.dtype)
+
+    return jnp.concatenate([finish(sa), finish(sb)], axis=1)
+
+
 def make_ring_attention_fn(axis_name: str, causal: bool = True):
     """Adapter with the ``attention_fn(q, k, v, mask)`` signature the
     transformer layers accept (mask ignored: causality is positional)."""
@@ -122,5 +250,16 @@ def make_ring_attention_fn(axis_name: str, causal: bool = True):
     def fn(q, k, v, mask=None):
         del mask
         return ring_attention(q, k, v, axis_name, causal=causal)
+
+    return fn
+
+
+def make_zigzag_ring_attention_fn(axis_name: str):
+    """Adapter for :func:`zigzag_ring_attention` (always causal; inputs
+    must be in zigzag shard layout, see :func:`zigzag_indices`)."""
+
+    def fn(q, k, v, mask=None):
+        del mask
+        return zigzag_ring_attention(q, k, v, axis_name)
 
     return fn

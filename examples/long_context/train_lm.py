@@ -60,8 +60,10 @@ def main(argv=None):
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--epochs", type=int, default=2)
     p.add_argument("--steps-per-epoch", type=int, default=20)
-    p.add_argument("--sp", choices=["none", "ring", "ulysses"], default="none",
-                   help="sequence parallelism over the 'intra' mesh axis")
+    p.add_argument("--sp", choices=["none", "ring", "zigzag", "ulysses"],
+                   default="none",
+                   help="sequence parallelism over the 'intra' mesh axis "
+                   "(zigzag = load-balanced causal ring, half ring's FLOPs)")
     p.add_argument("--no-flash", action="store_true",
                    help="disable the Pallas flash kernel (sp=none only)")
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
@@ -81,6 +83,13 @@ def main(argv=None):
     elif args.sp == "ring":
         attention_fn = make_ring_attention_fn("intra")
         sp_ways_eff = sp_ways
+    elif args.sp == "zigzag":
+        from chainermn_tpu.parallel.ring_attention import (
+            make_zigzag_ring_attention_fn,
+        )
+
+        attention_fn = make_zigzag_ring_attention_fn("intra")
+        sp_ways_eff = sp_ways
     else:
         attention_fn = make_ulysses_attention_fn("intra")
         sp_ways_eff = sp_ways
@@ -91,6 +100,11 @@ def main(argv=None):
         )
     if S % max(sp_ways_eff, 1):
         raise SystemExit(f"--seq-len {S} must divide by sp ways {sp_ways_eff}")
+    if args.sp == "zigzag" and S % (2 * sp_ways):
+        raise SystemExit(
+            f"--sp zigzag needs --seq-len divisible by 2*sp ways "
+            f"({2 * sp_ways}); got {S}"
+        )
     if args.sp != "none" and args.n_heads % sp_ways:
         raise SystemExit("ulysses/ring need n_heads % sp ways == 0")
 
@@ -142,10 +156,13 @@ def main(argv=None):
 
         carry = (params, mn_opt.init(params))
     else:
-        def body(params, opt_state, tok_l, tgt_l, wt_l):
+        def body(params, opt_state, tok_l, tgt_l, wt_l, pos_l):
             def loss_fn(params):
-                offset = lax.axis_index("intra") * S_local
-                logits = model.apply(params, tok_l, position_offset=offset)
+                # Explicit global positions: contiguous arange for
+                # ring/ulysses, the zigzag permutation for zigzag — the
+                # model indexes its positional table with them, so
+                # non-contiguous shard layouts stay correct.
+                logits = model.apply(params, tok_l, position_offset=pos_l)
                 ce = optax.softmax_cross_entropy_with_integer_labels(
                     logits, tgt_l
                 )
@@ -164,14 +181,24 @@ def main(argv=None):
         batch_spec = P("inter", "intra")
         mapped = comm.shard_map(
             body,
-            in_specs=(P(), P(), batch_spec, batch_spec, batch_spec),
+            in_specs=(P(), P(), batch_spec, batch_spec, batch_spec,
+                      P("intra")),
             out_specs=(P(), P(), P()),
         )
         jitted = jax.jit(mapped)
 
+        if args.sp == "zigzag":
+            from chainermn_tpu.parallel.ring_attention import zigzag_indices
+
+            seq_perm = np.asarray(zigzag_indices(S, sp_ways))
+        else:
+            seq_perm = np.arange(S)
+        positions = jnp.asarray(seq_perm, jnp.int32)
+
         def step(carry, batch):
             params, opt_state = carry
-            params, opt_state, loss = jitted(params, opt_state, *batch)
+            params, opt_state, loss = jitted(params, opt_state, *batch,
+                                             positions)
             return (params, opt_state), loss
 
         carry = (params, opt_state)
@@ -179,15 +206,20 @@ def main(argv=None):
     rng = np.random.RandomState(0)
     wt_np = np.ones((B, S), np.float32)
     wt_np[:, -1] = 0.0  # final position has no successor
-    wt = jnp.asarray(wt_np)
+    # Zigzag layout: batches are permuted into shard order on the host;
+    # targets/weights ride the same permutation (the loss is a positionwise
+    # sum, so it is permutation-invariant as long as all three agree).
+    perm = seq_perm if args.sp == "zigzag" else np.arange(S)
+    wt = jnp.asarray(wt_np[:, perm])
 
     last = float("nan")
     for epoch in range(args.epochs):
         t0, n_tok = time.perf_counter(), 0
         for _ in range(args.steps_per_epoch):
             tok_np = successor_batch(rng, B, S, vocab)
-            tok = jnp.asarray(tok_np)
-            tgt = jnp.asarray(np.roll(tok_np, -1, axis=1))
+            tgt_np = np.roll(tok_np, -1, axis=1)
+            tok = jnp.asarray(tok_np[:, perm])
+            tgt = jnp.asarray(tgt_np[:, perm])
             carry, last = step(carry, (tok, tgt, wt))
             n_tok += B * S
         sync(last)  # host readback: honest timing on all backends

@@ -98,7 +98,7 @@ def test_vit_pipeline_1f1b_smoke():
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("sp", ["none", "ring", "ulysses"])
+@pytest.mark.parametrize("sp", ["none", "ring", "zigzag", "ulysses"])
 def test_long_context_lm_smoke(sp):
     # sp=none is pure DP: the global batch must divide the 8-device world.
     extra = [] if sp == "none" else ["--dp", "2"]
