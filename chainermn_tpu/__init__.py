@@ -52,7 +52,10 @@ def __getattr__(name):
 
         return getattr(iterators, name)
     if name in ("global_except_hook",):
-        from chainermn_tpu import global_except_hook
+        # importlib, NOT `from chainermn_tpu import ...`: the from-import
+        # re-enters this __getattr__ before the submodule is bound and
+        # recurses forever.
+        import importlib
 
-        return global_except_hook
+        return importlib.import_module("chainermn_tpu.global_except_hook")
     raise AttributeError(f"module 'chainermn_tpu' has no attribute {name!r}")

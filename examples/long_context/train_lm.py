@@ -105,8 +105,10 @@ def main(argv=None):
             f"--sp zigzag needs --seq-len divisible by 2*sp ways "
             f"({2 * sp_ways}); got {S}"
         )
-    if args.sp != "none" and args.n_heads % sp_ways:
-        raise SystemExit("ulysses/ring need n_heads % sp ways == 0")
+    if args.sp == "ulysses" and args.n_heads % sp_ways:
+        # Only ulysses reshapes heads across the axis; ring/zigzag shard
+        # the sequence and accept any head count.
+        raise SystemExit("--sp ulysses needs n_heads % sp ways == 0")
 
     model = TransformerLM(
         vocab=vocab, d_model=args.d_model, n_heads=args.n_heads,
@@ -190,7 +192,7 @@ def main(argv=None):
         if args.sp == "zigzag":
             from chainermn_tpu.parallel.ring_attention import zigzag_indices
 
-            seq_perm = np.asarray(zigzag_indices(S, sp_ways))
+            seq_perm = zigzag_indices(S, sp_ways)
         else:
             seq_perm = np.arange(S)
         positions = jnp.asarray(seq_perm, jnp.int32)

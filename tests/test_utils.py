@@ -127,3 +127,24 @@ def test_strip_special():
 
     assert strip_special([5, 6, 2, 9, 9]) == [5, 6]      # cut at EOS
     assert strip_special([0, 5, 0, 6]) == [5, 6]         # drop PAD
+
+
+def test_facade_exposes_every_lazy_attribute():
+    """Regression: every name the lazy facade claims must resolve (a
+    from-import inside __getattr__ once recursed forever)."""
+    import chainermn_tpu as c
+
+    for name in [
+        "create_communicator", "CommunicatorBase", "build_mesh",
+        "create_multi_node_optimizer", "MultiNodeOptimizer",
+        "scatter_dataset", "create_empty_dataset",
+        "create_multi_node_evaluator", "create_multi_node_checkpointer",
+        "MultiNodeChainList", "functions",
+        "create_multi_node_iterator", "create_synchronized_iterator",
+        "create_prefetch_iterator", "global_except_hook",
+    ]:
+        assert getattr(c, name) is not None, name
+    import pytest as _pytest
+
+    with _pytest.raises(AttributeError):
+        c.definitely_not_an_attribute
