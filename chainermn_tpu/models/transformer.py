@@ -36,6 +36,8 @@ class MultiHeadAttention(nn.Module):
     n_heads: int
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[Callable] = None  # pluggable (ring/ulysses SP)
+    decode: bool = False        # incremental decoding with a KV cache
+    cache_len: int = 0          # cache capacity (max sequence length)
 
     @nn.compact
     def __call__(self, q_in, kv_in, mask=None):
@@ -46,6 +48,43 @@ class MultiHeadAttention(nn.Module):
         q = dense("query")(q_in)
         k = dense("key")(kv_in)
         v = dense("value")(kv_in)
+
+        if self.decode:
+            # KV cache (flax "cache" collection): one new token per call is
+            # written at the running index; attention runs over the whole
+            # cache with positions beyond the index masked.  Same param
+            # structure as the training path, so trained params drop in.
+            if self.cache_len <= 0:
+                raise ValueError("decode=True requires cache_len > 0")
+            if q.shape[1] != 1:
+                raise ValueError(
+                    f"decode mode consumes exactly one token per call, got "
+                    f"a length-{q.shape[1]} chunk (the single-position "
+                    f"cache mask would silently hide the chunk's own "
+                    f"tokens); feed tokens one at a time, as generate() does"
+                )
+            B = q.shape[0]
+            ck = self.variable(
+                "cache", "cached_key",
+                lambda: jnp.zeros((B, self.cache_len, self.n_heads, d_head),
+                                  k.dtype),
+            )
+            cv = self.variable(
+                "cache", "cached_value",
+                lambda: jnp.zeros((B, self.cache_len, self.n_heads, d_head),
+                                  v.dtype),
+            )
+            cidx = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            i = cidx.value
+            import jax.lax as _lax
+
+            ck.value = _lax.dynamic_update_slice(ck.value, k, (0, i, 0, 0))
+            cv.value = _lax.dynamic_update_slice(cv.value, v, (0, i, 0, 0))
+            cidx.value = i + q.shape[1]
+            k, v = ck.value, cv.value
+            mask = (jnp.arange(self.cache_len) <= i)[None, None, None, :]
 
         if self.attention_fn is not None:
             out = self.attention_fn(q, k, v, mask)
@@ -79,12 +118,15 @@ class EncoderLayer(nn.Module):
     d_ff: int
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[Callable] = None
+    decode: bool = False
+    cache_len: int = 0
 
     @nn.compact
     def __call__(self, x, mask=None):
         h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + MultiHeadAttention(
-            self.d_model, self.n_heads, self.dtype, self.attention_fn
+            self.d_model, self.n_heads, self.dtype, self.attention_fn,
+            decode=self.decode, cache_len=self.cache_len,
         )(h, h, mask)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         return x + FeedForward(self.d_model, self.d_ff, self.dtype)(h)
@@ -166,6 +208,7 @@ class TransformerLM(nn.Module):
     max_len: int = 2048
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[Callable] = None
+    decode: bool = False        # KV-cache incremental decoding (generate())
 
     @nn.compact
     def __call__(self, tokens, position_offset=None):
@@ -192,6 +235,80 @@ class TransformerLM(nn.Module):
             x = EncoderLayer(
                 self.d_model, self.n_heads, self.d_ff, self.dtype,
                 self.attention_fn, name=f"layer_{i}",
+                decode=self.decode, cache_len=self.max_len if self.decode else 0,
             )(x, mask)
         x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
         return embed.attend(x.astype(jnp.float32))
+
+
+def generate(
+    lm: "TransformerLM",
+    params,
+    prompt,
+    max_new_tokens: int,
+    rng=None,
+    temperature: float = 0.0,
+):
+    """Autoregressive generation with a KV cache — O(T·max_len) attention
+    instead of the O(T²·max_len) of re-running the prefix per token.
+
+    ``lm``: the TransformerLM the ``params`` were trained with (any
+    ``decode`` value — a decode twin is constructed here).
+    ``prompt``: (B, T) int32.  Greedy at ``temperature=0`` (default),
+    otherwise softmax sampling with ``rng``.
+    Returns (B, T + max_new_tokens) — prompt with the continuation.
+    """
+    import jax
+    from jax import lax
+
+    B, T = prompt.shape
+    total = T + max_new_tokens
+    if total > lm.max_len:
+        raise ValueError(
+            f"prompt + new tokens ({total}) exceed max_len {lm.max_len}"
+        )
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature > 0 requires rng")
+
+    dec = TransformerLM(
+        vocab=lm.vocab, d_model=lm.d_model, n_heads=lm.n_heads,
+        d_ff=lm.d_ff, n_layers=lm.n_layers, max_len=lm.max_len,
+        dtype=lm.dtype, decode=True,
+    )
+    # eval_shape: cache geometry without allocating (and then discarding)
+    # a second full parameter set; zeros ARE the empty cache (index 0).
+    cache_shapes = jax.eval_shape(
+        lambda: dec.init(
+            jax.random.PRNGKey(0), jnp.zeros((B, 1), jnp.int32),
+            position_offset=0,
+        )["cache"]
+    )
+    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
+
+    pad = jnp.zeros((B, max_new_tokens), prompt.dtype)
+    prompt_padded = jnp.concatenate([prompt, pad], axis=1)
+
+    def step(carry, t):
+        cache, prev = carry
+        # Feed the prompt while it lasts, then the previous sample.
+        tok = jnp.where(t < T, prompt_padded[:, t], prev)
+        logits, upd = dec.apply(
+            {"params": params["params"] if "params" in params else params,
+             "cache": cache},
+            tok[:, None], position_offset=t, mutable=["cache"],
+        )
+        logits = logits[:, 0]                       # (B, vocab)
+        if temperature > 0.0:
+            key = jax.random.fold_in(rng, t)
+            nxt = jax.random.categorical(key, logits / temperature, axis=-1)
+        else:
+            nxt = logits.argmax(-1)
+        return (upd["cache"], nxt.astype(prompt.dtype)), nxt.astype(prompt.dtype)
+
+    (_, _), ys = lax.scan(
+        step, (cache, jnp.zeros((B,), prompt.dtype)), jnp.arange(total - 1)
+    )
+    # ys[t] is the model's prediction AFTER consuming token t; the
+    # continuation is ys[T-1 : T-1+max_new_tokens].
+    gen = ys[T - 1 :].T                              # (B, max_new_tokens)
+    return jnp.concatenate([prompt, gen], axis=1)

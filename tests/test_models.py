@@ -86,3 +86,72 @@ def test_dummy_communicator():
     group = dummy_communicators(3)
     group[0].bcast_obj("x", root=0)
     assert group[2].bcast_obj(None, root=0) == "x"
+
+
+def test_kv_cache_generate_matches_full_prefix():
+    """KV-cache incremental decoding must reproduce the naive
+    full-prefix-per-token greedy decode token for token."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chainermn_tpu.models.transformer import TransformerLM, generate
+
+    vocab, T, new = 32, 6, 8
+    lm = TransformerLM(
+        vocab=vocab, d_model=32, n_heads=2, d_ff=64, n_layers=2,
+        max_len=32, dtype=jnp.float32,
+    )
+    prompt = jax.random.randint(jax.random.PRNGKey(0), (2, T), 0, vocab)
+    params = lm.init(jax.random.PRNGKey(1), prompt)
+
+    out = generate(lm, params, prompt, max_new_tokens=new)
+    assert out.shape == (2, T + new)
+    np.testing.assert_array_equal(np.asarray(out[:, :T]), np.asarray(prompt))
+
+    # Naive oracle: re-run the full prefix for every new token.
+    toks = prompt
+    for _ in range(new):
+        logits = lm.apply(params, toks)
+        nxt = logits[:, -1].argmax(-1).astype(prompt.dtype)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(toks))
+
+
+def test_kv_cache_generate_sampling_and_bounds():
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from chainermn_tpu.models.transformer import TransformerLM, generate
+
+    lm = TransformerLM(
+        vocab=16, d_model=16, n_heads=2, d_ff=32, n_layers=1,
+        max_len=8, dtype=jnp.float32,
+    )
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    params = lm.init(jax.random.PRNGKey(0), prompt)
+    out = generate(
+        lm, params, prompt, max_new_tokens=4,
+        rng=jax.random.PRNGKey(2), temperature=1.0,
+    )
+    assert out.shape == (1, 8)
+    with pytest.raises(ValueError, match="exceed max_len"):
+        generate(lm, params, prompt, max_new_tokens=5)
+    with pytest.raises(ValueError, match="requires rng"):
+        generate(lm, params, prompt, max_new_tokens=2, temperature=0.5)
+
+
+def test_kv_cache_rejects_multi_token_chunk():
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    lm = TransformerLM(
+        vocab=16, d_model=16, n_heads=2, d_ff=32, n_layers=1,
+        max_len=8, dtype=jnp.float32, decode=True,
+    )
+    with pytest.raises(ValueError, match="one token per call"):
+        lm.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
