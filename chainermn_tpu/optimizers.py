@@ -342,6 +342,47 @@ class MultiNodeOptimizer:
         grads = jax.tree.map(lambda g: g / n_accum, gacc)
         return lsum / n_accum, auxs, grads
 
+    def _apply_update(self, params, state, grads, loss_scale=None):
+        """Allreduce local grads and apply the inner optimizer — the shared
+        tail of the stage-0 step bodies.
+
+        With ``double_buffering``: allreduce this step's grads into buffer
+        B, *apply* last step's averaged buffer A (reference
+        _DoubleBufferingOptimizer), skipping the inner update entirely on
+        step 0.  Scaled gradients (``loss_scale``) are unscaled exactly
+        once, at application time.
+        """
+        comm = self.communicator
+        opt = self.actual_optimizer
+        if self.double_buffering:
+            new_mean = comm.allreduce_grad(grads)
+            stale = state.comm_buf
+
+            def do_update(operand):
+                params, inner, stale = operand
+                if loss_scale is not None:
+                    stale = jax.tree.map(lambda g: g / loss_scale, stale)
+                updates, inner = opt.update(stale, inner, params)
+                return optax.apply_updates(params, updates), inner
+
+            params, inner = lax.cond(
+                state.step > 0,
+                do_update,
+                lambda operand: (operand[0], operand[1]),
+                (params, state.inner, stale),
+            )
+            return params, MultiNodeOptimizerState(
+                inner=inner, step=state.step + 1, comm_buf=new_mean
+            )
+        grads = comm.allreduce_grad(grads)
+        if loss_scale is not None:
+            grads = jax.tree.map(lambda g: g / loss_scale, grads)
+        updates, inner = opt.update(grads, state.inner, params)
+        params = optax.apply_updates(params, updates)
+        return params, MultiNodeOptimizerState(
+            inner=inner, step=state.step + 1, comm_buf=()
+        )
+
     def make_train_step(
         self,
         loss_fn: Callable,
@@ -397,39 +438,9 @@ class MultiNodeOptimizer:
                 one, params, batch, self._base_key(rng, state.step), n_accum
             )
             loss = lax.pmean(loss, axes)
-
-            if self.double_buffering:
-                # Reference _DoubleBufferingOptimizer: allreduce this
-                # step's grads into buffer B, *apply* last step's averaged
-                # buffer A; skip the inner update entirely on step 0.
-                new_mean = comm.allreduce_grad(grads)
-                stale = state.comm_buf
-
-                def do_update(operand):
-                    params, inner, stale = operand
-                    if loss_scale is not None:
-                        stale = jax.tree.map(lambda g: g / loss_scale, stale)
-                    updates, inner = opt.update(stale, inner, params)
-                    return optax.apply_updates(params, updates), inner
-
-                params, inner = lax.cond(
-                    state.step > 0,
-                    do_update,
-                    lambda operand: (operand[0], operand[1]),
-                    (params, state.inner, stale),
-                )
-                new_state = MultiNodeOptimizerState(
-                    inner=inner, step=state.step + 1, comm_buf=new_mean
-                )
-            else:
-                grads = comm.allreduce_grad(grads)
-                if loss_scale is not None:
-                    grads = jax.tree.map(lambda g: g / loss_scale, grads)
-                updates, inner = opt.update(grads, state.inner, params)
-                params = optax.apply_updates(params, updates)
-                new_state = MultiNodeOptimizerState(
-                    inner=inner, step=state.step + 1, comm_buf=()
-                )
+            params, new_state = self._apply_update(
+                params, state, grads, loss_scale
+            )
             if has_aux:
                 return params, new_state, loss, aux
             return params, new_state, loss
@@ -673,12 +684,13 @@ class MultiNodeOptimizer:
 
         Returns ``step(params, opt_state, model_state, batch) ->
         (params, opt_state, model_state, loss)``.
+
+        ``double_buffering`` works here too: step N applies step N−1's
+        averaged gradients (first step reduce-only), while model state
+        (BatchNorm statistics) always updates from the CURRENT step —
+        statistics are running estimates, not gradients, so staleness
+        semantics do not apply to them.
         """
-        if self.double_buffering:
-            raise NotImplementedError(
-                "double_buffering with mutable model state is not supported "
-                "yet; use make_train_step or double_buffering=False"
-            )
         if self.zero_stage > 0:
             raise NotImplementedError(
                 "make_train_step_with_state does not support zero_stage>0 "
@@ -701,12 +713,7 @@ class MultiNodeOptimizer:
                 else x,
                 new_model_state,
             )
-            grads = comm.allreduce_grad(grads)
-            updates, inner = opt.update(grads, state.inner, params)
-            params = optax.apply_updates(params, updates)
-            new_state = MultiNodeOptimizerState(
-                inner=inner, step=state.step + 1, comm_buf=()
-            )
+            params, new_state = self._apply_update(params, state, grads)
             return params, new_state, new_model_state, loss
 
         mapped = comm.shard_map(

@@ -356,3 +356,40 @@ def test_zero3_materialize_is_cached(mesh):
     flat2 = opt.shard_params(params)
     opt.materialize(flat2)
     assert len(opt._z3_jit) == 2  # cache hit, no new entries
+
+
+def test_double_buffering_with_model_state(mesh):
+    """Double buffering + mutable model state: params follow the one-step
+    -stale rule while BatchNorm-style statistics update from the CURRENT
+    step."""
+    comm = create_communicator("xla_ici", mesh=mesh)
+    params, batch = make_problem()
+    model_state = {"running": jnp.zeros((1,), jnp.float32)}
+
+    def sloss(params, mstate, b):
+        x, y = b
+        pred = x @ params["w"] + params["b"]
+        new_state = {"running": mstate["running"] * 0.9 + 0.1 * jnp.mean(pred)}
+        return jnp.mean((pred - y) ** 2), new_state
+
+    opt = create_multi_node_optimizer(
+        optax.sgd(0.1), comm, double_buffering=True
+    )
+    state = opt.init(params)
+    step = opt.make_train_step_with_state(sloss, donate=False)
+
+    # Step 0: reduce-only — params unchanged, model state DOES update.
+    p1, state, m1, _ = step(params, state, model_state, batch)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(params[k]))
+    assert float(jnp.abs(m1["running"]).sum()) > 0
+
+    # Step 1 applies step 0's gradients.
+    p2, state, m2, _ = step(p1, state, m1, batch)
+    g0 = jax.grad(lambda p: sloss(p, model_state, batch)[0])(params)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(p2[k]),
+            np.asarray(params[k]) - 0.1 * np.asarray(g0[k]),
+            rtol=1e-5, atol=1e-6,
+        )
