@@ -220,14 +220,22 @@ def _dkv_kernel(
 
 
 def _flash_bh_bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
-                  interpret):
-    """(BH, S, D) flash attention backward: (dq, dk, dv)."""
+                  interpret, dlse=None):
+    """(BH, S, D) flash attention backward: (dq, dk, dv).
+
+    ``dlse``: optional cotangent of the row log-sum-exp output (used when
+    the LSE itself feeds downstream math, e.g. cross-block merging in ring
+    attention).  Since ∂lse_i/∂s_ij = p_ij, the whole contribution folds
+    into the per-row residual: ds = p·(dp − (δ − dlse)).
+    """
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     # delta_i = rowsum(dO ∘ O) — cheap elementwise, XLA handles it.
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     )[..., None]                                   # (BH, Sq, 1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)[..., None]
 
     dq = pl.pallas_call(
         functools.partial(
@@ -309,6 +317,42 @@ def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, do):
 
 
 _flash_bh.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_with_lse(q, k, v, scale, causal, block_q, block_k,
+                             interpret):
+    """(BH, S, D) flash attention returning ``(o, lse)`` — both
+    differentiable.  For composition layers (ring/zigzag) that merge
+    blocks via the row log-sum-exp: the LSE cotangent folds into the
+    backward kernels' residual (see :func:`_flash_bh_bwd`)."""
+    return _flash_bh_fwd(
+        q, k, v, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+
+
+def _flash_lse_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _flash_bh_fwd(
+        q, k, v, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_lse_vjp_bwd(scale, causal, block_q, block_k, interpret, res, cots):
+    q, k, v, o, lse = res
+    do, dlse = cots
+    # lse output is (BH, S, 1) from the kernel; normalize cotangent shape.
+    dlse2 = dlse[..., 0] if dlse.ndim == 3 else dlse
+    dq, dk, dv = _flash_bh_bwd(
+        q, k, v, o, lse, do, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret, dlse=dlse2,
+    )
+    return dq, dk, dv
+
+
+flash_attention_with_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 
 def _xla_attention(q, k, v, scale, causal):
@@ -396,6 +440,42 @@ def flash_attention(
     vt = v.transpose(0, 2, 1, 3).reshape(B * H, Sk, D)
     out = _flash_bh(qt, kt, vt, scale, causal, block_q, block_k, interpret)
     return out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
+
+
+def flash_block_plan(S: int, D: int, dtype, interpret: bool):
+    """(usable, block_size) for running the kernel over length-``S``
+    chunks — the single block-policy used by composition layers
+    (ring/zigzag).  Mirrors :func:`flash_attention`'s gating: pallas-TPU
+    importable, D ≤ 128 compiled, blocks always DIVIDING S (a
+    non-dividing block floors the grid and silently drops tail rows —
+    interpret mode included), sized near the measured-optimal S/16
+    clamped to [128, 512]."""
+    if not _HAS_PLTPU:
+        return False, 0
+    if interpret:
+        return True, 128 if S % 128 == 0 else S
+    if D > 128:
+        return False, 0
+    target = int(np.clip(S // 16, 128, 512))
+    cands = [b for b in (128, 256, 512) if S % b == 0]
+    if cands:
+        return True, min(cands, key=lambda b: abs(b - target))
+    sublane = 16 if dtype == jnp.bfloat16 else 8
+    if S <= 512 and S % sublane == 0:
+        return True, S
+    return False, 0
+
+
+def to_bh(x):
+    """(B, S, H, D) → (B*H, S, D), the kernel layout."""
+    B, S, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+
+def from_bh(x, B: int, H: int):
+    """(B*H, S, D) → (B, S, H, D)."""
+    _, S, D = x.shape
+    return x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
 
 
 def make_flash_attention_fn(causal: bool = True):

@@ -129,3 +129,20 @@ def test_auto_block_divides_sequence():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3
     )
+
+
+def test_flash_block_plan_blocks_always_divide():
+    """A block that does not divide the chunk would floor the Pallas grid
+    and silently drop tail rows — the plan must never emit one."""
+    from chainermn_tpu.ops.flash_attention import flash_block_plan
+
+    for S in (8, 64, 128, 192, 256, 384, 512, 2048):
+        for interpret in (True, False):
+            ok, b = flash_block_plan(S, 64, jnp.float32, interpret)
+            if ok:
+                assert S % b == 0, (S, interpret, b)
+    # Compiled path prefers the measured-optimal ~S/16 among divisors.
+    ok, b = flash_block_plan(2048, 64, jnp.float32, False)
+    assert ok and b == 128
+    ok, b = flash_block_plan(8192, 64, jnp.float32, False)
+    assert ok and b == 512
