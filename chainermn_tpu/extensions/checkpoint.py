@@ -122,6 +122,11 @@ class MultiNodeCheckpointer:
         os.makedirs(self.dir, exist_ok=True)
         self._pending: Optional[threading.Thread] = None
         self._pending_error: Optional[BaseException] = None
+        # Catch up on rotations a previous run decided but didn't finish
+        # (e.g. a rank that never ran another cleanup): drop our own files
+        # of tombstoned generations so stale tombstones get released
+        # instead of lingering to shadow future saves.
+        self._cleanup(ranks=(comm.rank,))
 
     # -- file layout -----------------------------------------------------
     def _snap(self, iteration: int, rank: int) -> str:
@@ -129,6 +134,9 @@ class MultiNodeCheckpointer:
 
     def _marker(self, iteration: int, rank: int) -> str:
         return os.path.join(self.dir, f"done_iter_{iteration}.rank{rank}")
+
+    def _tomb(self, iteration: int) -> str:
+        return os.path.join(self.dir, f"rotated_iter_{iteration}")
 
     # -- API (reference: checkpointer.save / maybe_load) ------------------
     def save(self, state: Any, iteration: int, block: bool = True) -> None:
@@ -141,6 +149,13 @@ class MultiNodeCheckpointer:
         """
         self.wait()
         rank = self.comm.rank
+        # A fresh save of this iteration supersedes any earlier rotation
+        # of the same number (dir reuse across runs): clear the tombstone
+        # so cleanup cannot delete the checkpoint we are about to write.
+        try:
+            os.remove(self._tomb(iteration))
+        except OSError:
+            pass
         host_state = _to_host(state)
 
         def write():
@@ -150,15 +165,23 @@ class MultiNodeCheckpointer:
             os.replace(tmp, self._snap(iteration, rank))
             with open(self._marker(iteration, rank), "w") as f:
                 f.write("ok")
-            self._cleanup()
 
         if block:
             write()
             self.comm.barrier()
+            # Cleanup only after every rank has committed this generation:
+            # deleting a rotated generation before a straggler finished
+            # choosing its newest-consistent set could turn its maybe_load
+            # into a FileNotFoundError.
+            self._cleanup()
         else:
             def run():
                 try:
                     write()
+                    # No barrier on the background thread; deleting other
+                    # ranks' files here could race a straggler's
+                    # maybe_load, so each rank rotates only its own.
+                    self._cleanup(ranks=(rank,))
                 except BaseException as e:  # noqa: BLE001 — surfaced in wait()
                     self._pending_error = e
 
@@ -174,29 +197,84 @@ class MultiNodeCheckpointer:
         if err is not None:
             raise err
 
-    def _generations(self):
+    def _generations(self, names=None):
+        if names is None:
+            names = os.listdir(self.dir)
         pat = re.compile(r"done_iter_(\d+)\.rank(\d+)$")
         gens: dict[int, int] = {}
-        for fn in os.listdir(self.dir):
+        for fn in names:
             m = pat.match(fn)
             if m:
                 gens[int(m.group(1))] = gens.get(int(m.group(1)), 0) + 1
+        for it in self._tombstoned(names):
+            gens.pop(it, None)
         return gens
 
-    def _consistent_generations(self):
+    def _tombstoned(self, names=None):
+        if names is None:
+            names = os.listdir(self.dir)
+        pat = re.compile(r"rotated_iter_(\d+)$")
         return sorted(
-            it for it, cnt in self._generations().items() if cnt >= self.comm.size
+            int(m.group(1)) for m in map(pat.match, names) if m
         )
 
-    def _cleanup(self):
-        done = self._consistent_generations()
+    def _consistent_generations(self, names=None):
+        return sorted(
+            it
+            for it, cnt in self._generations(names).items()
+            if cnt >= self.comm.size
+        )
+
+    def _cleanup(self, ranks=None):
+        """Rotate old generations.
+
+        Rotation is decided ONCE, while the generation is still fully
+        consistent, by writing a tombstone (``rotated_iter_N``); every
+        rank's later cleanup sees the tombstone and removes its share, so
+        nothing leaks even when each rank deletes only its own files.
+        ``ranks``: which ranks' files to delete — all (blocking mode,
+        after the barrier) or just our own (async mode, where deleting a
+        straggler's files could race its ``maybe_load``; each rank reads
+        only its own snapshot, so own-file deletion can never break a
+        concurrent load on another rank).
+        """
+        # One directory snapshot serves every check below (shared/network
+        # storage: listings are not free), updated locally as we write
+        # tombstones and delete files.
+        names = set(os.listdir(self.dir))
+        done = self._consistent_generations(names)
+        if ranks is None:
+            ranks = range(self.comm.size)
         for it in done[: -self.keep] if len(done) > self.keep else []:
-            for rank in range(self.comm.size):
-                for p in (self._snap(it, rank), self._marker(it, rank)):
+            with open(self._tomb(it), "w") as f:
+                f.write("rotated")
+            names.add(os.path.basename(self._tomb(it)))
+        for it in self._tombstoned(names):
+            for rank in ranks:
+                snap = self._snap(it, rank)
+                for p in (snap, snap + ".tmp", self._marker(it, rank)):
                     try:
                         os.remove(p)
+                        names.discard(os.path.basename(p))
                     except OSError:
                         pass
+            # Drop the tombstone once every rank's files — including any
+            # crash-orphaned .tmp — are gone (any rank may observe this;
+            # double-removal is swallowed).
+            gone = not any(
+                os.path.basename(p) in names
+                for rank in range(self.comm.size)
+                for p in (
+                    self._snap(it, rank),
+                    self._snap(it, rank) + ".tmp",
+                    self._marker(it, rank),
+                )
+            )
+            if gone:
+                try:
+                    os.remove(self._tomb(it))
+                except OSError:
+                    pass
 
     def maybe_load(self, state: Any = None) -> Tuple[Any, Optional[int]]:
         """Restore the newest consistent generation, or return ``state``

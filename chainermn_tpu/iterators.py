@@ -116,13 +116,16 @@ def create_prefetch_iterator(
         _put_or_stop((_END, None))
 
     t = threading.Thread(target=producer, daemon=True)
-    t.start()
 
     def gen():
+        # The producer starts lazily on the first next(): an abandoned,
+        # never-started generator then owns no thread and pins no device
+        # buffers (the finally block below would never run for it).
         # The finally block is the shutdown path: closing or abandoning the
         # iterator mid-stream (e.g. `break` in the consuming loop) signals
         # the producer to exit and drains queued batches so their device
         # buffers are released instead of pinned for the process lifetime.
+        t.start()
         try:
             while True:
                 item = q.get()
@@ -137,6 +140,13 @@ def create_prefetch_iterator(
                 yield item
         finally:
             stop.set()
+            # Join before draining: a producer already inside its ≤0.1 s
+            # q.put attempt could otherwise land one last batch AFTER the
+            # drain, pinning its device buffers for the process lifetime.
+            # The join is bounded (every put attempt re-checks `stop`);
+            # the timeout only guards a producer blocked inside the user's
+            # iterator itself.
+            t.join(timeout=1.0)
             try:
                 while True:
                     q.get_nowait()

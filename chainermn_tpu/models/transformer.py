@@ -54,6 +54,15 @@ class MultiHeadAttention(nn.Module):
             # written at the running index; attention runs over the whole
             # cache with positions beyond the index masked.  Same param
             # structure as the training path, so trained params drop in.
+            if self.attention_fn is not None:
+                raise ValueError(
+                    "decode=True is incompatible with attention_fn: the "
+                    "pluggable adapters (flash/ring/ulysses) impose their "
+                    "own causality with the query at local position 0 and "
+                    "ignore the cache mask, so they would silently attend "
+                    "to the wrong cache slots; build the decode twin "
+                    "without attention_fn (generate() does this)"
+                )
             if self.cache_len <= 0:
                 raise ValueError("decode=True requires cache_len > 0")
             if q.shape[1] != 1:

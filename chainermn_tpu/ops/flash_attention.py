@@ -453,7 +453,20 @@ def flash_block_plan(S: int, D: int, dtype, interpret: bool):
     if not _HAS_PLTPU:
         return False, 0
     if interpret:
-        return True, 128 if S % 128 == 0 else S
+        # Interpreter-mode block policy: a full-S block materializes the
+        # S×S matrix (defeating the O(S) property), while a degenerate
+        # block means (S/b)² interpreter invocations — an effective hang.
+        # So: smallest aligned divisor keeping the grid ≤ 64 per axis,
+        # else the largest divisor ≤ 512 under the same grid cap, else
+        # refuse and let the caller fall back / raise, as the compiled
+        # branch does.
+        cands = [b for b in (128, 256, 512) if S % b == 0 and S <= b * 64]
+        if cands:
+            return True, min(cands)
+        b = max(d for d in range(1, min(S, 512) + 1) if S % d == 0)
+        if b * 64 < S:
+            return False, 0
+        return True, b
     if D > 128:
         return False, 0
     target = int(np.clip(S // 16, 128, 512))

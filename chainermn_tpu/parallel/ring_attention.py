@@ -230,8 +230,12 @@ def zigzag_ring_attention(
         use_flash = flash_ok and not interpret   # off-TPU interpret is slow
     elif use_flash and not flash_ok:
         raise ValueError(
-            f"use_flash=True but chunk shape (C={C}, D={q.shape[-1]}) does "
-            f"not meet the kernel's tiling constraints"
+            f"use_flash=True but the kernel block plan refused chunk shape "
+            f"(C={C}, D={q.shape[-1]}): either it violates the compiled "
+            f"kernel's tiling constraints (D > 128, or C has no aligned "
+            f"divisor), or — in interpreter mode off-TPU — no block size "
+            f"both divides C and keeps the interpreter grid tractable; "
+            f"pass use_flash=False (or None) to use the XLA path"
         )
 
     def block_stats(qc, kc, vc, causal):

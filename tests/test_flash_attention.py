@@ -146,3 +146,30 @@ def test_flash_block_plan_blocks_always_divide():
     assert ok and b == 128
     ok, b = flash_block_plan(8192, 64, jnp.float32, False)
     assert ok and b == 512
+
+
+def test_flash_block_plan_interpret_clamps_block():
+    """Interpret-mode plans for non-128-divisible S must still emit a
+    small block (largest divisor ≤ 512), never the full S — a full-S
+    block materializes S×S in the interpreter (ADVICE r1)."""
+    from chainermn_tpu.ops.flash_attention import flash_block_plan
+
+    ok, b = flash_block_plan(12000, 64, jnp.float32, True)
+    assert ok and b <= 512 and 12000 % b == 0 and b == 500
+    ok, b = flash_block_plan(97, 64, jnp.float32, True)   # prime ≤ 512
+    assert ok and b == 97
+
+
+def test_decode_rejects_attention_fn():
+    """decode=True + attention_fn would silently mis-attend (the adapters
+    impose their own causality and ignore the cache mask) — must raise."""
+    import pytest
+    from chainermn_tpu.models.transformer import MultiHeadAttention
+
+    mha = MultiHeadAttention(
+        d_model=16, n_heads=2, dtype=jnp.float32, decode=True, cache_len=4,
+        attention_fn=lambda q, k, v, m: q,
+    )
+    x = jnp.zeros((1, 1, 16), jnp.float32)
+    with pytest.raises(ValueError, match="incompatible with attention_fn"):
+        mha.init(jax.random.PRNGKey(0), x, x)
