@@ -21,15 +21,12 @@ import time
 
 import jax
 
+from chainermn_tpu.utils.profiling import setup_compilation_cache
+
 # Persistent compilation cache: ResNet-50's train step is a big program and
 # this environment's remote-compile path is slow; cache compiles across
 # bench runs (first run pays, reruns are seconds).
-_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
-try:
-    jax.config.update("jax_compilation_cache_dir", _CACHE)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:
-    pass
+setup_compilation_cache()
 
 import jax.numpy as jnp
 import numpy as np

@@ -17,8 +17,10 @@ Differentiable: a ``custom_vjp`` with explicit FlashAttention-2-style
 backward kernels — the forward saves one fp32 log-sum-exp per row, and the
 dQ / dK+dV kernels recompute probabilities blockwise from it, so neither
 pass ever materializes the S×S matrix.  Measured on a v5e-class chip at
-S=8192/bf16: forward ~18x faster than XLA's materialized-logits attention,
-forward+backward ~1.4x — with O(S) memory in both passes.
+S=8192/bf16/D=128 (slope-timed; see docs/performance.md "Measuring"):
+forward ~67 TFLOP/s (4.5-4.9x XLA's materialized-logits attention),
+forward+backward 4.4x, backward alone ~81 TFLOP/s — at the chip's own
+sustained matmul roofline — with O(S) memory in both passes.
 
 Falls back to interpreter mode off-TPU (tests run the same kernel code on
 the CPU mesh) and to plain XLA attention for shapes the kernel does not
@@ -69,9 +71,12 @@ def _attn_kernel(
 
     @pl.when(run)
     def _():
-        q = q_ref[0].astype(jnp.float32)          # (block_q, D)
-        k = k_ref[0].astype(jnp.float32)          # (block_k, D)
-        v = v_ref[0].astype(jnp.float32)
+        # MXU-native matmuls: operands stay in their input dtype (bf16 on
+        # the training path — one MXU pass) with fp32 accumulation via
+        # preferred_element_type; only the softmax runs in fp32.
+        q = q_ref[0]                              # (block_q, D)
+        k = k_ref[0]                              # (block_k, D)
+        v = v_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
 
         if causal:
@@ -87,7 +92,7 @@ def _attn_kernel(
 
         l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
         acc_ref[:] = acc_ref[:] * alpha[:, None] + jnp.dot(
-            p, v, preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
         )
         m_ref[:, 0] = m_new
 
@@ -156,10 +161,10 @@ def _dq_kernel(
 
     @pl.when(run)
     def _():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
@@ -167,7 +172,7 @@ def _dq_kernel(
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0, :, :])             # exact probabilities
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0, :, :]) * scale
+        ds = (p * (dp - delta_ref[0, :, :]) * scale).astype(k.dtype)
         dq_acc[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
     @pl.when(ik == n_k - 1)
@@ -198,19 +203,20 @@ def _dkv_kernel(
 
     @pl.when(run)
     def _():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0, :, :])
-        dv_acc[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        pt = p.astype(do.dtype).T
+        dv_acc[:] += jnp.dot(pt, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0, :, :]) * scale
+        ds = (p * (dp - delta_ref[0, :, :]) * scale).astype(q.dtype)
         dk_acc[:] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
 
     @pl.when(iq == n_q - 1)
@@ -387,8 +393,9 @@ def flash_attention(
 
     ``block_q``/``block_k`` default to an auto size, ``S/16`` clamped to
     [128, 512] — measured optimal per length on a v5e-class chip
-    (S=2048→128, 4096→256, 8192→512; at 8192/bf16 the kernel runs ~18x
-    faster than XLA's materialized-logits attention).
+    (S=2048→128, 4096→256, 8192→512; at 8192/bf16/D=128 the kernel
+    sustains ~67 TFLOP/s forward, 4.5-4.9x XLA's materialized-logits
+    attention, slope-timed per docs/performance.md).
     """
     B, Sq, H, D = q.shape
     Sk = k.shape[1]

@@ -1,0 +1,124 @@
+"""Worker for the on-TPU test tier (run as a subprocess with the DEFAULT
+environment, i.e. the axon/TPU plugin active — unlike every other worker,
+which scrubs it).
+
+Subcommands:
+  probe      — print the default backend name and exit
+  flash      — compiled (non-interpret) flash attention fwd+bwd vs the XLA
+               oracle ON THE CHIP; asserts and prints OK
+  trainstep  — 3 data-parallel train steps on whatever backend is active;
+               prints per-step losses (the pytest side runs this twice,
+               chip vs CPU, and compares)
+
+The reference gated GPU tests with ``@attr.gpu`` markers (SURVEY §4); this
+is that tier for TPU — the compiled kernel path is correctness-asserted on
+the real chip, not just timed by bench.py.
+"""
+
+import sys
+
+import jax
+
+from chainermn_tpu.utils.profiling import setup_compilation_cache
+
+setup_compilation_cache()
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def probe():
+    print(jax.default_backend())
+
+
+def flash():
+    from chainermn_tpu.ops.flash_attention import _xla_attention, flash_attention
+
+    assert jax.default_backend() in ("tpu", "axon"), jax.default_backend()
+    rng = np.random.RandomState(0)
+    for dtype, causal, S, tol in [
+        (jnp.bfloat16, True, 1024, 2e-2),
+        (jnp.bfloat16, False, 1024, 2e-2),
+        (jnp.float32, True, 1024, 2e-3),
+    ]:
+        B, H, D = 1, 2, 64
+        q, k, v = (
+            jnp.asarray(rng.randn(B, S, H, D), dtype) / (D**0.25)
+            for _ in range(3)
+        )
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=causal, interpret=False)
+            return (o.astype(jnp.float32) ** 2).sum()
+
+        def loss_xla(q, k, v):
+            o = _xla_attention(q, k, v, 1.0 / D**0.5, causal)
+            return (o.astype(jnp.float32) ** 2).sum()
+
+        o = jax.jit(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=causal, interpret=False
+            )
+        )(q, k, v)
+        ref = jax.jit(
+            lambda q, k, v: _xla_attention(q, k, v, 1.0 / D**0.5, causal)
+        )(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(o, np.float32), np.asarray(ref, np.float32),
+            rtol=tol, atol=tol,
+        )
+
+        g = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+        gref = jax.jit(jax.grad(loss_xla, argnums=(0, 1, 2)))(q, k, v)
+        for a, b, name in zip(g, gref, "qkv"):
+            a32, b32 = np.asarray(a, np.float32), np.asarray(b, np.float32)
+            # Grad magnitudes vary over orders of magnitude; compare at the
+            # scale of the gradient itself.
+            denom = max(1e-6, float(np.abs(b32).max()))
+            err = float(np.abs(a32 - b32).max()) / denom
+            assert err < 10 * tol, (name, dtype, causal, err)
+        print(f"flash-on-tpu ok: dtype={jnp.dtype(dtype).name} causal={causal}")
+    print("OK")
+
+
+def trainstep():
+    import optax
+
+    import chainermn_tpu
+    from chainermn_tpu.communicators import create_communicator
+
+    # TPU's DEFAULT f32 matmul precision uses bf16 MXU passes (~1e-3 off
+    # a CPU fp32 run); force true fp32 so chip-vs-CPU trajectories are
+    # comparable at tight tolerance.
+    jax.config.update("jax_default_matmul_precision", "highest")
+    comm = create_communicator("xla_ici")
+    rng = np.random.RandomState(0)
+    W = jnp.asarray(rng.randn(16, 4), jnp.float32) * 0.1
+    params = {"w": W}
+
+    def loss_fn(params, batch):
+        x, y = batch
+        pred = x @ params["w"]
+        return jnp.mean((pred - y) ** 2)
+
+    opt = optax.sgd(0.1)
+    mopt = chainermn_tpu.create_multi_node_optimizer(opt, comm)
+    state = mopt.init(params)
+    step = mopt.make_train_step(loss_fn)
+
+    # Fixed global batch so the chip run (whatever the pool's device
+    # count) and the 1-device CPU run draw identical data; DP averaging
+    # makes the trajectory device-count-invariant as long as 16 divides
+    # the device count's shard arithmetic.
+    n = 16
+    for i in range(3):
+        x = jnp.asarray(rng.randn(n, 16), jnp.float32)
+        y = jnp.asarray(rng.randn(n, 4), jnp.float32)
+        batch = comm.global_batch((x, y))
+        params, state, loss = step(params, state, batch)
+        print(f"loss {i}: {float(loss):.8f}")
+
+
+if __name__ == "__main__":
+    cmd = sys.argv[1]
+    {"probe": probe, "flash": flash, "trainstep": trainstep}[cmd]()
