@@ -57,14 +57,47 @@ def bench_one(comm, nbytes: int, dtype, iters: int, warmup: int) -> dict:
         out = comm.eager_allreduce_grad(out)
     sync(out)
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = comm.eager_allreduce_grad(out)
-    sync(out)
-    dt = (time.perf_counter() - t0) / iters
+    import jax
+
+    if jax.default_backend() == "cpu":
+        # Per-iteration sync.  Two reasons: the host-readback constant the
+        # slope method exists to cancel is a property of the tunneled TPU
+        # (CPU readback is ~free), and letting many 8-virtual-device
+        # programs pile up in flight starves the single-host execution
+        # pool mid-rendezvous (XLA CPU aborts after 40 s: "Expected 8
+        # threads to join").
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = comm.eager_allreduce_grad(out)
+            sync(out)
+        dt = (time.perf_counter() - t0) / iters
+    else:
+        # Slope timing (profiling.slope_time): cancels the tunneled
+        # chip's ~100 ms readback constant.
+        from chainermn_tpu.utils.profiling import slope_time
+
+        def run(k):
+            nonlocal out
+            t0 = time.perf_counter()
+            for _ in range(k):
+                out = comm.eager_allreduce_grad(out)
+            sync(out)
+            return time.perf_counter() - t0
+
+        dt = slope_time(run, iters)
 
     payload = elems_per_dev * np.dtype(dtype).itemsize
+    # A degenerate op (n=1 pass-through) can slope-time below measurement
+    # noise; clamp so the report never shows negative time/bandwidth.
+    dt = max(dt, 1e-9)
     bus_bw = 2 * (n - 1) / n * payload / dt if n > 1 else 0.0
+    if dt <= 1e-9:
+        return {
+            "metric": "allreduce_bus_bw", "communicator": comm.name,
+            "devices": n, "bytes": payload, "value": 0.0, "unit": "GB/s",
+            "time_ms": 0.0, "algo_bw_GBps": 0.0,
+            "note": "below measurement noise",
+        }
     return {
         "metric": "allreduce_bus_bw",
         "communicator": comm.name,

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from chainermn_tpu.ops.flash_attention import _xla_attention, flash_attention
-from chainermn_tpu.utils.profiling import sync
+from chainermn_tpu.utils.profiling import slope_time, sync
 
 
 def timed(fn, *args, iters=10, warmup=2):
@@ -49,8 +49,7 @@ def timed(fn, *args, iters=10, warmup=2):
         sync(out)
         return time.perf_counter() - t0
 
-    t1, t2 = run(iters), run(5 * iters)
-    return (t2 - t1) / (4 * iters)
+    return slope_time(run, iters)
 
 
 def timed_chain(fn, *args, iters=10, warmup=1):
@@ -74,19 +73,18 @@ def timed_chain(fn, *args, iters=10, warmup=1):
             return c
         return run
 
-    short, long = chain(iters), chain(5 * iters)
+    chains = {n: chain(n) for n in (iters, 5 * iters)}
     rest = tuple(args[1:])
-    for _ in range(warmup):
-        sync(short(args[0], rest))
-        sync(long(args[0], rest))
+    for f in chains.values():
+        for _ in range(warmup):
+            sync(f(args[0], rest))
 
-    def run_once(f):
+    def run(n):
         t0 = time.perf_counter()
-        sync(f(args[0], rest))
+        sync(chains[n](args[0], rest))
         return time.perf_counter() - t0
 
-    t1, t2 = run_once(short), run_once(long)
-    return (t2 - t1) / (4 * iters)
+    return slope_time(run, iters)
 
 
 def main():

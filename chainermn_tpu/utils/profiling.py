@@ -37,6 +37,22 @@ def setup_compilation_cache(cache_dir: Optional[str] = None) -> None:
         pass
 
 
+def slope_time(run, n1: int, n2: Optional[int] = None) -> float:
+    """Per-iteration time via the two-point slope ``(T₂−T₁)/(n₂−n₁)``.
+
+    ``run(n)`` must execute ``n`` iterations (chained, or relying on the
+    device's FIFO program order) and end with ONE :func:`sync`.  On the
+    tunneled TPU backend that final readback costs ~100 ms (measured;
+    docs/performance.md "Measuring"), so a single run over-reports
+    per-iteration time by ~100/n ms — the slope between two run lengths
+    cancels the constant exactly.  Used by bench.py and benchmarks/*.
+    """
+    if n2 is None:
+        n2 = 5 * n1
+    t1, t2 = run(n1), run(n2)
+    return (t2 - t1) / (n2 - n1)
+
+
 def sync(tree):
     """Hard execution barrier: force every array in ``tree`` to finish
     executing by reading one element back to the host.
