@@ -88,9 +88,7 @@ def bench_one(comm, nbytes: int, dtype, iters: int, warmup: int) -> dict:
 
     payload = elems_per_dev * np.dtype(dtype).itemsize
     # A degenerate op (n=1 pass-through) can slope-time below measurement
-    # noise; clamp so the report never shows negative time/bandwidth.
-    dt = max(dt, 1e-9)
-    bus_bw = 2 * (n - 1) / n * payload / dt if n > 1 else 0.0
+    # noise (even negative); report zeros rather than a garbage bandwidth.
     if dt <= 1e-9:
         return {
             "metric": "allreduce_bus_bw", "communicator": comm.name,
@@ -98,6 +96,7 @@ def bench_one(comm, nbytes: int, dtype, iters: int, warmup: int) -> dict:
             "time_ms": 0.0, "algo_bw_GBps": 0.0,
             "note": "below measurement noise",
         }
+    bus_bw = 2 * (n - 1) / n * payload / dt if n > 1 else 0.0
     return {
         "metric": "allreduce_bus_bw",
         "communicator": comm.name,

@@ -51,7 +51,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from . import mesh_utils
+from . import kvtransport, mesh_utils
 
 try:  # jax >= 0.4.35
     from jax import shard_map as _shard_map
@@ -76,6 +76,7 @@ class CommunicatorBase:
     """
 
     name = "base"
+    _plane_count = 0  # class-level: SPMD construction order, see __init__
 
     def __init__(
         self,
@@ -96,6 +97,15 @@ class CommunicatorBase:
         # back after.  bfloat16 is the TPU-native choice.
         self.allreduce_grad_dtype = (
             jnp.dtype(allreduce_grad_dtype) if allreduce_grad_dtype else None
+        )
+        # Host-plane transport context.  Communicator construction is SPMD
+        # (every process builds the same communicators in the same order —
+        # the same contract MPI_Comm_create relies on), so a class-level
+        # creation counter yields matching key namespaces on all processes,
+        # playing the role of an MPI communicator context id.
+        CommunicatorBase._plane_count += 1
+        self._obj_plane = kvtransport.ObjectPlane(
+            f"comm{CommunicatorBase._plane_count}", self.rank, self.size
         )
 
     # ------------------------------------------------------------------
@@ -368,14 +378,58 @@ class CommunicatorBase:
     # ------------------------------------------------------------------
     # Host/object plane (reference pickle-over-MPI *_obj methods)
     # ------------------------------------------------------------------
+    def send_obj(self, obj, dest: int, tag: int = 0) -> None:
+        """True host-plane point-to-point send of a pickled object to
+        process ``dest`` — the reference's ``MpiCommunicatorBase.send``.
+        No collective is involved: the payload rides the coordination
+        service's KV store (chunked, see
+        :mod:`chainermn_tpu.communicators.kvtransport`), so only the two
+        endpoints participate.  Matched ``send_obj``/``recv_obj`` pairs on
+        the same (edge, tag) must occur in the same order on both sides,
+        exactly MPI's matching rule."""
+        if not (0 <= dest < self.size) or dest == self.rank:
+            raise ValueError(
+                f"send_obj dest must be another process in [0, {self.size}), "
+                f"got {dest} (self.rank={self.rank})"
+            )
+        self._require_kv("send_obj")
+        self._obj_plane.send(obj, dest, tag)
+
+    def recv_obj(self, source: int, tag: int = 0):
+        """Blocking host-plane receive from process ``source`` (the
+        reference's ``MpiCommunicatorBase.recv``)."""
+        if not (0 <= source < self.size) or source == self.rank:
+            raise ValueError(
+                f"recv_obj source must be another process in [0, {self.size}), "
+                f"got {source} (self.rank={self.rank})"
+            )
+        self._require_kv("recv_obj")
+        return self._obj_plane.recv(source, tag)
+
+    def _require_kv(self, op: str) -> None:
+        if not kvtransport.available():
+            raise RuntimeError(
+                f"{op} needs the jax.distributed coordination service "
+                "(call jax.distributed.initialize); single-process runs "
+                "have no peer to talk to"
+            )
+
     def bcast_obj(self, obj, root: int = 0):
         if self.size == 1:
             return obj
+        if kvtransport.available():
+            # Chunked KV-store broadcast: exact payload bytes on the wire,
+            # the reference's ``chunked_bcast_obj``
+            # (REF:.../_communication_utility.py).
+            return self._obj_plane.bcast(obj, root)
+        return self._bcast_obj_devices(obj, root)
+
+    def _bcast_obj_devices(self, obj, root: int):
+        """Fallback broadcast over device collectives for multi-process
+        setups without a coordination-service client."""
         from jax.experimental import multihost_utils
 
         payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-        # Chunked length-then-payload protocol, as the reference's
-        # ``chunked_bcast_obj`` (REF:.../_communication_utility.py).
         n = multihost_utils.broadcast_one_to_all(
             np.int64(payload.size), is_source=self.rank == root
         )
@@ -386,8 +440,15 @@ class CommunicatorBase:
         return pickle.loads(np.asarray(out).tobytes())
 
     def gather_obj(self, obj, root: int = 0):
+        """Gather every process's object; the full list is returned on all
+        ranks (allgather semantics — the reference returns it only at
+        ``root``, but rank-symmetric returns keep SPMD callers branch-free
+        and every in-tree caller wants them).  Payloads travel at their
+        exact size — no pad-to-max."""
         if self.size == 1:
             return [obj]
+        if kvtransport.available():
+            return self._obj_plane.allgather(obj)
         from jax.experimental import multihost_utils
 
         payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
@@ -415,6 +476,10 @@ class CommunicatorBase:
     def scatter_obj(self, objs, root: int = 0):
         if self.size == 1:
             return objs[0] if self.rank == root else None
+        if kvtransport.available():
+            # Point-to-point: each rank receives only its own element
+            # (reference ``scatter_obj`` wire profile), not the whole list.
+            return self._obj_plane.scatter(objs, root)
         objs = self.bcast_obj(objs, root)
         return objs[self.rank]
 

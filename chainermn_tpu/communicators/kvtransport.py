@@ -1,0 +1,184 @@
+"""Host-plane transport over the jax.distributed coordination-service KV
+store — the TPU-native analogue of the reference's pickled-MPI transport.
+
+The reference's ``MpiCommunicatorBase`` gives every *process* an eager,
+point-to-point-capable object plane: ``send``/``recv`` of pickled payloads
+between two ranks, and chunked collective object transport
+(``chunked_bcast_obj``, REF:chainermn/communicators/_communication_utility.py)
+that splits large pickles to respect MPI message-count limits.  JAX has no
+MPI, but every multi-process JAX job already runs a coordination service
+(the ``jax.distributed.initialize`` coordinator) whose distributed KV store
+is reachable from all processes over DCN.  This module builds the same
+transport primitives on it:
+
+* ``put_bytes``/``get_bytes`` — a chunked length-then-payload protocol.
+  Values are split into ``CHUNK_BYTES`` pieces (the coordination service is
+  gRPC-backed; one huge value would trip message-size ceilings exactly the
+  way one huge ``MPI_Bcast`` trips ``int`` count limits) and a header key is
+  written *last*, so a reader blocking on the header never observes a
+  partial write.
+* single-reader keys are deleted by their reader; multi-reader keys are
+  garbage-collected by the *last* reader, discovered with an atomic
+  ``key_value_increment`` ack counter.
+
+Keys are namespaced under ``chainermn_tpu/`` and carry a monotone
+per-(edge, tag) sequence number maintained independently on each side.
+Matched send/recv pairs advance their counters in lockstep (the same
+SPMD-ordering contract MPI tags rely on), so no two in-flight transfers
+ever share a key and stale keys cannot be re-read.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any
+
+# 1 MiB chunks: comfortably under gRPC's default 4 MB message ceiling while
+# keeping round-trips low for the multi-MB pickles scatter_dataset ships.
+CHUNK_BYTES = 1 << 20
+
+# Object-plane operations are collective or matched-pair; a peer more than
+# five minutes behind is dead (the global except hook's domain), so block
+# that long before surfacing the timeout.
+TIMEOUT_MS = 300_000
+
+_PREFIX = "chainermn_tpu"
+
+
+def client():
+    """The process's coordination-service client, or None outside
+    ``jax.distributed`` (single-process runs)."""
+    from jax._src import distributed
+
+    return distributed.global_state.client
+
+
+def available() -> bool:
+    return client() is not None
+
+
+def put_bytes(key: str, data: bytes) -> None:
+    """Publish ``data`` under ``key`` (chunked; header written last)."""
+    c = client()
+    n = max(1, -(-len(data) // CHUNK_BYTES))
+    for i in range(n):
+        c.key_value_set_bytes(
+            f"{key}/c{i}", bytes(data[i * CHUNK_BYTES : (i + 1) * CHUNK_BYTES])
+        )
+    c.key_value_set(f"{key}/hdr", str(n))
+
+
+def get_bytes(key: str, *, timeout_ms: int = TIMEOUT_MS) -> tuple[bytes, int]:
+    """Block until ``key`` is published; return (payload, n_chunks)."""
+    c = client()
+    n = int(c.blocking_key_value_get(f"{key}/hdr", timeout_ms))
+    parts = [
+        c.blocking_key_value_get_bytes(f"{key}/c{i}", timeout_ms)
+        for i in range(n)
+    ]
+    return b"".join(parts), n
+
+
+def delete(key: str, n_chunks: int) -> None:
+    c = client()
+    for i in range(n_chunks):
+        c.key_value_delete(f"{key}/c{i}")
+    c.key_value_delete(f"{key}/hdr")
+
+
+def ack_and_collect(key: str, n_chunks: int, n_readers: int) -> None:
+    """Reader-side GC for multi-reader keys: the last of ``n_readers`` to
+    ack (atomic increment) deletes the data; earlier readers return
+    immediately.  Safe because readers only ack *after* consuming."""
+    c = client()
+    if int(c.key_value_increment(f"{key}/ack", 1)) >= n_readers:
+        delete(key, n_chunks)
+        c.key_value_delete(f"{key}/ack")
+
+
+class ObjectPlane:
+    """Sequenced pickled-object transport for one communicator.
+
+    Each instance keeps per-(operation, edge) sequence counters; because the
+    object plane is SPMD-ordered (every process issues the same collective
+    calls in the same order, and matched ``send_obj``/``recv_obj`` pairs are
+    ordered per edge+tag), both sides of any transfer derive the same key
+    without negotiation — the role MPI's (communicator, tag, order)
+    matching plays in the reference.
+    """
+
+    def __init__(self, namespace: str, rank: int, size: int):
+        self.namespace = namespace
+        self.rank = rank
+        self.size = size
+        self._seq: dict[Any, int] = {}
+
+    def _next(self, slot) -> int:
+        s = self._seq.get(slot, 0)
+        self._seq[slot] = s + 1
+        return s
+
+    def _key(self, *parts) -> str:
+        return "/".join([_PREFIX, self.namespace, *map(str, parts)])
+
+    # -- point-to-point ------------------------------------------------
+    def send(self, obj, dest: int, tag: int = 0) -> None:
+        seq = self._next(("p2p", self.rank, dest, tag))
+        put_bytes(self._key("p2p", self.rank, dest, tag, seq), pickle.dumps(obj))
+
+    def recv(self, source: int, tag: int = 0, *, timeout_ms: int = TIMEOUT_MS):
+        seq = self._next(("p2p", source, self.rank, tag))
+        key = self._key("p2p", source, self.rank, tag, seq)
+        data, n = get_bytes(key, timeout_ms=timeout_ms)
+        delete(key, n)  # sole reader
+        return pickle.loads(data)
+
+    # -- collectives ---------------------------------------------------
+    def bcast(self, obj, root: int):
+        seq = self._next(("bcast", root))
+        key = self._key("bcast", root, seq)
+        if self.rank == root:
+            put_bytes(key, pickle.dumps(obj))
+            return obj
+        data, n = get_bytes(key)
+        ack_and_collect(key, n, self.size - 1)
+        return pickle.loads(data)
+
+    def allgather(self, obj) -> list:
+        seq = self._next(("gather",))
+        base = self._key("gather", seq)
+        put_bytes(f"{base}/{self.rank}", pickle.dumps(obj))
+        out = []
+        for r in range(self.size):
+            if r == self.rank:
+                out.append(obj)
+                continue
+            data, n = get_bytes(f"{base}/{r}")
+            out.append(pickle.loads(data))
+            ack_and_collect(f"{base}/{r}", n, self.size - 1)
+        return out
+
+    def scatter(self, objs, root: int):
+        """Point-to-point scatter: root sends each rank exactly its element
+        (the reference's ``scatter_obj``), not a broadcast of the whole list
+        — O(total) root-side wire, O(own) per receiver.  Keys live in their
+        own ``scatter`` namespace so user p2p traffic on any tag can never
+        interleave with internal collective matching (the role of MPI's
+        per-context internal tags)."""
+        seq = self._next(("scatter", root))
+        if self.rank == root:
+            if objs is None or len(objs) != self.size:
+                raise ValueError(
+                    f"scatter_obj needs a length-{self.size} list at root"
+                )
+            for r in range(self.size):
+                if r != root:
+                    put_bytes(
+                        self._key("scatter", root, r, seq),
+                        pickle.dumps(objs[r]),
+                    )
+            return objs[root]
+        key = self._key("scatter", root, self.rank, seq)
+        data, n = get_bytes(key)
+        delete(key, n)  # sole reader
+        return pickle.loads(data)

@@ -247,6 +247,19 @@ def test_obj_plane_single_process(mesh):
     comm.barrier()
 
 
+def test_p2p_obj_validation(mesh):
+    """send_obj/recv_obj reject self/out-of-range peers and, single-process,
+    report the missing coordination service instead of hanging.  (The real
+    rank0→rank1 transfer runs in tests/_mp_worker.py.)"""
+    import pytest
+
+    comm = create_communicator("naive", mesh=mesh)
+    with pytest.raises(ValueError, match="send_obj dest"):
+        comm.send_obj("x", dest=0)  # self (size==1: no valid peer)
+    with pytest.raises(ValueError, match="recv_obj source"):
+        comm.recv_obj(source=5)
+
+
 def test_single_host_rejects_multihost_mesh(devices8):
     from chainermn_tpu.communicators import SingleHostCommunicator
 

@@ -2,7 +2,9 @@
 of the reference's ``mpiexec -n 2 pytest`` CI trick (SURVEY §4): REAL
 process boundaries, the coordinator standing in for MPI's control plane.
 Exercises the cross-process object plane (bcast/gather/allreduce_obj),
-barrier, dataset scattering, and parameter broadcast."""
+host-plane p2p (send_obj/recv_obj over the KV store, incl. multi-chunk
+payloads), barrier, dataset scattering, parameter broadcast, the
+communicator × wire-dtype matrix, and a cross-process ZeRO-3 step."""
 
 import os
 import socket
@@ -40,7 +42,7 @@ def test_two_process_object_plane(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=420)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
