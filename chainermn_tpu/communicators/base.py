@@ -220,19 +220,88 @@ class CommunicatorBase:
         return lax.dynamic_slice_in_dim(x, self.axis_index() * chunk, chunk, axis=0)
 
     def ppermute(self, x, perm):
-        """Raw ``lax.ppermute`` over this communicator's (flattened) world.
+        """``lax.ppermute`` semantics over this communicator's (flattened)
+        world: destinations named in ``perm`` (a list of (src, dst) flat
+        ranks) receive their source's value, everyone else receives zeros.
+        The building block of differentiable send/recv
+        (chainermn_tpu.functions.point_to_point, mirroring
+        REF:chainermn/functions/point_to_point_communication.py).
 
-        ``perm`` is a list of (src, dst) flattened ranks. The building block
-        of differentiable send/recv (chainermn_tpu.functions.point_to_point,
-        mirroring REF:chainermn/functions/point_to_point_communication.py).
+        Multi-axis lowering moves O(message) bytes, not O(world):
+
+        1. *Per-axis product* — when the perm factors into one well-defined
+           injective map per mesh axis (single-pair p2p, neighbor exchange,
+           grid translations without flat wrap-around), it lowers to one
+           ppermute hop per non-identity axis.
+        2. *Uniform flat shift* — a constant ``(dst - src) % world`` shift
+           (the ring case: ``ring_exchange``, pipelines over 2-axis meshes)
+           wraps between rows, so the row hop is issued at both ``q`` and
+           ``q+1`` and wrapped columns select the latter: 3 hops total.
+        3. General perms that factor neither way fall back to
+           ``all_gather`` + slice — correct for arbitrary routing, at
+           world-volume cost (no in-tree caller hits this; the fallback
+           exists for API completeness).
+
+        All paths are natively differentiable (ppermute transposes to the
+        reversed perm; the wrap select is elementwise).
         """
         if len(self.axes) == 1:
             return lax.ppermute(x, self.axes[0], perm)
-        # Flattened permutation over a multi-axis world: express each flat
-        # rank as (inter, intra) coordinates and chain two ppermutes would
-        # not compose for arbitrary perms; instead collapse via all_gather +
-        # dynamic slice (correct, if not minimal). Single-axis worlds (the
-        # common pipeline case) take the fast path above.
+        sizes = [self.mesh.shape[a] for a in self.axes]
+        n = self.device_size
+
+        def coords(r):
+            c = []
+            for s in reversed(sizes):
+                c.append(r % s)
+                r //= s
+            return tuple(reversed(c))  # row-major; axes[0] slowest
+
+        # (1) per-axis product decomposition.
+        axis_maps: list[dict[int, int]] = [{} for _ in sizes]
+        factors = True
+        for s, d in perm:
+            cs, cd = coords(s), coords(d)
+            for k in range(len(sizes)):
+                if axis_maps[k].setdefault(cs[k], cd[k]) != cd[k]:
+                    factors = False
+                    break
+            if not factors:
+                break
+        if factors:
+            factors = all(
+                len(set(m.values())) == len(m) for m in axis_maps
+            )
+        if factors:
+            out = x
+            for k, axis in enumerate(self.axes):
+                pairs = sorted(axis_maps[k].items())
+                if all(a == b for a, b in pairs):
+                    continue  # identity along this axis: no hop needed
+                out = lax.ppermute(out, axis, pairs)
+            return self._mask_non_dsts(out, perm)
+
+        # (2) uniform flat shift over a 2-axis world.
+        shifts = {(d - s) % n for s, d in perm}
+        if len(shifts) == 1 and len(sizes) == 2:
+            shift = shifts.pop()
+            n_inter, n_intra = sizes
+            q, r = divmod(shift, n_intra)
+            xj = lax.ppermute(
+                x, self.axes[1],
+                [(j, (j + r) % n_intra) for j in range(n_intra)],
+            )
+            row = lambda k: lax.ppermute(  # noqa: E731
+                xj, self.axes[0],
+                [(i, (i + k) % n_inter) for i in range(n_inter)],
+            )
+            xq = row(q) if q % n_inter else xj
+            # Columns j < r received a value that wrapped past the end of
+            # its row and must advance one extra inter row.
+            out = jnp.where(lax.axis_index(self.axes[1]) < r, row(q + 1), xq)
+            return self._mask_non_dsts(out, perm)
+
+        # (3) general fallback: collapse via all_gather + slice.
         src_for_dst = {d: s for s, d in perm}
         gathered = lax.all_gather(x, self.axes, axis=0)
         idx = self.axis_index()
@@ -246,6 +315,16 @@ class CommunicatorBase:
             jnp.zeros_like(x),
         )
         return picked
+
+    def _mask_non_dsts(self, out, perm):
+        """Zero devices that are not a destination in ``perm`` — hop
+        decompositions deliver junk to bystander devices that a true
+        flattened ppermute would zero-fill."""
+        dsts = {d for _, d in perm}
+        if len(dsts) == self.device_size:
+            return out
+        table = jnp.asarray([d in dsts for d in range(self.device_size)])
+        return jnp.where(table[self.axis_index()], out, jnp.zeros_like(out))
 
     # ------------------------------------------------------------------
     # Model plane (traced): the two methods every training step uses
@@ -395,16 +474,20 @@ class CommunicatorBase:
         self._require_kv("send_obj")
         self._obj_plane.send(obj, dest, tag)
 
-    def recv_obj(self, source: int, tag: int = 0):
+    def recv_obj(self, source: int, tag: int = 0,
+                 timeout_ms: int | None = None):
         """Blocking host-plane receive from process ``source`` (the
-        reference's ``MpiCommunicatorBase.recv``)."""
+        reference's ``MpiCommunicatorBase.recv``).  Waits indefinitely by
+        default (MPI semantics); a finite ``timeout_ms`` raises instead,
+        and the sequence stream stays intact so the receive may be
+        retried."""
         if not (0 <= source < self.size) or source == self.rank:
             raise ValueError(
                 f"recv_obj source must be another process in [0, {self.size}), "
                 f"got {source} (self.rank={self.rank})"
             )
         self._require_kv("recv_obj")
-        return self._obj_plane.recv(source, tag)
+        return self._obj_plane.recv(source, tag, timeout_ms=timeout_ms)
 
     def _require_kv(self, op: str) -> None:
         if not kvtransport.available():

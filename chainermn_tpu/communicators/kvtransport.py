@@ -31,16 +31,18 @@ ever share a key and stale keys cannot be re-read.
 from __future__ import annotations
 
 import pickle
+import time
 from typing import Any
 
 # 1 MiB chunks: comfortably under gRPC's default 4 MB message ceiling while
 # keeping round-trips low for the multi-MB pickles scatter_dataset ships.
 CHUNK_BYTES = 1 << 20
 
-# Object-plane operations are collective or matched-pair; a peer more than
-# five minutes behind is dead (the global except hook's domain), so block
-# that long before surfacing the timeout.
-TIMEOUT_MS = 300_000
+# Blocking gets wait indefinitely by default — MPI semantics: a slow peer
+# is waited for; a *dead* peer is the global except hook's job to kill.
+# The wait is implemented as poll slices so a caller-supplied finite
+# timeout (recv_obj's escape hatch) is honored promptly.
+POLL_SLICE_MS = 60_000
 
 _PREFIX = "chainermn_tpu"
 
@@ -68,12 +70,38 @@ def put_bytes(key: str, data: bytes) -> None:
     c.key_value_set(f"{key}/hdr", str(n))
 
 
-def get_bytes(key: str, *, timeout_ms: int = TIMEOUT_MS) -> tuple[bytes, int]:
+def _blocking_get(fn, key: str, timeout_ms: int | None):
+    """Call a blocking KV getter, waiting forever when ``timeout_ms`` is
+    None (polling in ``POLL_SLICE_MS`` slices).  Non-deadline errors
+    propagate immediately."""
+    deadline = (
+        None if timeout_ms is None else time.monotonic() + timeout_ms / 1e3
+    )
+    while True:
+        if deadline is None:
+            slice_ms = POLL_SLICE_MS
+        else:
+            remaining = int((deadline - time.monotonic()) * 1000)
+            if remaining <= 0:
+                remaining = 1
+            slice_ms = min(POLL_SLICE_MS, remaining)
+        try:
+            return fn(key, slice_ms)
+        except Exception as e:  # jaxlib surfaces DEADLINE_EXCEEDED as XlaRuntimeError
+            if "DEADLINE" not in str(e).upper():
+                raise
+            if deadline is not None and time.monotonic() >= deadline:
+                raise
+
+
+def get_bytes(
+    key: str, *, timeout_ms: int | None = None
+) -> tuple[bytes, int]:
     """Block until ``key`` is published; return (payload, n_chunks)."""
     c = client()
-    n = int(c.blocking_key_value_get(f"{key}/hdr", timeout_ms))
+    n = int(_blocking_get(c.blocking_key_value_get, f"{key}/hdr", timeout_ms))
     parts = [
-        c.blocking_key_value_get_bytes(f"{key}/c{i}", timeout_ms)
+        _blocking_get(c.blocking_key_value_get_bytes, f"{key}/c{i}", timeout_ms)
         for i in range(n)
     ]
     return b"".join(parts), n
@@ -105,6 +133,12 @@ class ObjectPlane:
     ordered per edge+tag), both sides of any transfer derive the same key
     without negotiation — the role MPI's (communicator, tag, order)
     matching plays in the reference.
+
+    Counters commit only after the transfer succeeds, so a p2p call that
+    raises (e.g. a finite ``timeout_ms`` expiring) can be retried without
+    desynchronizing the stream.  A *collective* that fails midway leaves
+    the plane's state undefined across processes — as a failed MPI
+    collective does — and the job should abort (the except hook's role).
     """
 
     def __init__(self, namespace: str, rank: int, size: int):
@@ -113,40 +147,50 @@ class ObjectPlane:
         self.size = size
         self._seq: dict[Any, int] = {}
 
-    def _next(self, slot) -> int:
-        s = self._seq.get(slot, 0)
-        self._seq[slot] = s + 1
-        return s
+    def _peek(self, slot) -> int:
+        return self._seq.get(slot, 0)
+
+    def _commit(self, slot) -> None:
+        self._seq[slot] = self._seq.get(slot, 0) + 1
 
     def _key(self, *parts) -> str:
         return "/".join([_PREFIX, self.namespace, *map(str, parts)])
 
     # -- point-to-point ------------------------------------------------
     def send(self, obj, dest: int, tag: int = 0) -> None:
-        seq = self._next(("p2p", self.rank, dest, tag))
-        put_bytes(self._key("p2p", self.rank, dest, tag, seq), pickle.dumps(obj))
+        slot = ("p2p", self.rank, dest, tag)
+        put_bytes(
+            self._key("p2p", self.rank, dest, tag, self._peek(slot)),
+            pickle.dumps(obj),
+        )
+        self._commit(slot)
 
-    def recv(self, source: int, tag: int = 0, *, timeout_ms: int = TIMEOUT_MS):
-        seq = self._next(("p2p", source, self.rank, tag))
-        key = self._key("p2p", source, self.rank, tag, seq)
+    def recv(
+        self, source: int, tag: int = 0, *, timeout_ms: int | None = None
+    ):
+        slot = ("p2p", source, self.rank, tag)
+        key = self._key("p2p", source, self.rank, tag, self._peek(slot))
         data, n = get_bytes(key, timeout_ms=timeout_ms)
         delete(key, n)  # sole reader
+        self._commit(slot)
         return pickle.loads(data)
 
     # -- collectives ---------------------------------------------------
     def bcast(self, obj, root: int):
-        seq = self._next(("bcast", root))
-        key = self._key("bcast", root, seq)
+        slot = ("bcast", root)
+        key = self._key("bcast", root, self._peek(slot))
         if self.rank == root:
             put_bytes(key, pickle.dumps(obj))
+            self._commit(slot)
             return obj
         data, n = get_bytes(key)
         ack_and_collect(key, n, self.size - 1)
+        self._commit(slot)
         return pickle.loads(data)
 
     def allgather(self, obj) -> list:
-        seq = self._next(("gather",))
-        base = self._key("gather", seq)
+        slot = ("gather",)
+        base = self._key("gather", self._peek(slot))
         put_bytes(f"{base}/{self.rank}", pickle.dumps(obj))
         out = []
         for r in range(self.size):
@@ -156,6 +200,7 @@ class ObjectPlane:
             data, n = get_bytes(f"{base}/{r}")
             out.append(pickle.loads(data))
             ack_and_collect(f"{base}/{r}", n, self.size - 1)
+        self._commit(slot)
         return out
 
     def scatter(self, objs, root: int):
@@ -165,7 +210,8 @@ class ObjectPlane:
         own ``scatter`` namespace so user p2p traffic on any tag can never
         interleave with internal collective matching (the role of MPI's
         per-context internal tags)."""
-        seq = self._next(("scatter", root))
+        slot = ("scatter", root)
+        seq = self._peek(slot)
         if self.rank == root:
             if objs is None or len(objs) != self.size:
                 raise ValueError(
@@ -177,8 +223,10 @@ class ObjectPlane:
                         self._key("scatter", root, r, seq),
                         pickle.dumps(objs[r]),
                     )
+            self._commit(slot)
             return objs[root]
         key = self._key("scatter", root, self.rank, seq)
         data, n = get_bytes(key)
         delete(key, n)  # sole reader
+        self._commit(slot)
         return pickle.loads(data)
