@@ -287,19 +287,25 @@ class CommunicatorBase:
             shift = shifts.pop()
             n_inter, n_intra = sizes
             q, r = divmod(shift, n_intra)
-            xj = lax.ppermute(
-                x, self.axes[1],
-                [(j, (j + r) % n_intra) for j in range(n_intra)],
-            )
+            if r:
+                xj = lax.ppermute(
+                    x, self.axes[1],
+                    [(j, (j + r) % n_intra) for j in range(n_intra)],
+                )
+            else:
+                xj = x  # row-multiple shift: no intra hop, no wrap
             row = lambda k: lax.ppermute(  # noqa: E731
                 xj, self.axes[0],
                 [(i, (i + k) % n_inter) for i in range(n_inter)],
             )
             xq = row(q) if q % n_inter else xj
-            # Columns j < r received a value that wrapped past the end of
-            # its row and must advance one extra inter row.
-            out = jnp.where(lax.axis_index(self.axes[1]) < r, row(q + 1), xq)
-            return self._mask_non_dsts(out, perm)
+            if r:
+                # Columns j < r received a value that wrapped past the end
+                # of its row and must advance one extra inter row.
+                xq = jnp.where(
+                    lax.axis_index(self.axes[1]) < r, row(q + 1), xq
+                )
+            return self._mask_non_dsts(xq, perm)
 
         # (3) general fallback: collapse via all_gather + slice.
         src_for_dst = {d: s for s, d in perm}

@@ -70,13 +70,10 @@ def put_bytes(key: str, data: bytes) -> None:
     c.key_value_set(f"{key}/hdr", str(n))
 
 
-def _blocking_get(fn, key: str, timeout_ms: int | None):
-    """Call a blocking KV getter, waiting forever when ``timeout_ms`` is
-    None (polling in ``POLL_SLICE_MS`` slices).  Non-deadline errors
-    propagate immediately."""
-    deadline = (
-        None if timeout_ms is None else time.monotonic() + timeout_ms / 1e3
-    )
+def _blocking_get(fn, key: str, deadline: float | None):
+    """Call a blocking KV getter, waiting until ``deadline`` (monotonic
+    seconds; None = forever), polling in ``POLL_SLICE_MS`` slices.
+    Non-deadline errors propagate immediately."""
     while True:
         if deadline is None:
             slice_ms = POLL_SLICE_MS
@@ -97,11 +94,16 @@ def _blocking_get(fn, key: str, timeout_ms: int | None):
 def get_bytes(
     key: str, *, timeout_ms: int | None = None
 ) -> tuple[bytes, int]:
-    """Block until ``key`` is published; return (payload, n_chunks)."""
+    """Block until ``key`` is published; return (payload, n_chunks).
+    ``timeout_ms`` bounds the WHOLE receive (one deadline shared by the
+    header and every chunk), not each KV round-trip."""
     c = client()
-    n = int(_blocking_get(c.blocking_key_value_get, f"{key}/hdr", timeout_ms))
+    deadline = (
+        None if timeout_ms is None else time.monotonic() + timeout_ms / 1e3
+    )
+    n = int(_blocking_get(c.blocking_key_value_get, f"{key}/hdr", deadline))
     parts = [
-        _blocking_get(c.blocking_key_value_get_bytes, f"{key}/c{i}", timeout_ms)
+        _blocking_get(c.blocking_key_value_get_bytes, f"{key}/c{i}", deadline)
         for i in range(n)
     ]
     return b"".join(parts), n

@@ -63,11 +63,22 @@ class SyntheticSeqDataset:
 
 def batch_iterator(dataset, batch_size, *, shuffle=True, seed=0, drop_last=True):
     """Minimal epoch iterator over an indexable dataset, yielding stacked
-    numpy batches — the examples' stand-in for Chainer's iterators."""
+    numpy batches — the examples' stand-in for Chainer's iterators.
+
+    Batch assembly goes through the native ``parallel_gather`` (csrc/
+    hostbuf.cpp): a multithreaded memcpy into the contiguous batch buffer,
+    the ``pack_params`` idea of
+    REF:chainermn/communicators/_memory_utility.py applied to the one
+    host-side copy that sits on the input-pipeline critical path."""
+    from chainermn_tpu.utils import native
+
     n = len(dataset)
     order = np.random.RandomState(seed).permutation(n) if shuffle else np.arange(n)
     stop = n - (n % batch_size) if drop_last else n
     for start in range(0, stop, batch_size):
         idx = order[start : start + batch_size]
         items = [dataset[int(i)] for i in idx]
-        yield tuple(np.stack([it[j] for it in items]) for j in range(len(items[0])))
+        yield tuple(
+            native.parallel_gather([np.asarray(it[j]) for it in items])
+            for j in range(len(items[0]))
+        )

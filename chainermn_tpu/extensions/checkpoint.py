@@ -23,6 +23,12 @@ may immediately mutate/donate the live state): checkpoint cost overlaps
 the next training steps, the reference-era pattern of pausing the trainer
 to snapshot is gone.  ``wait()`` joins the in-flight write; ``save`` and
 ``maybe_load`` join it implicitly.
+
+Snapshots use a framed native format (see the v2 section below): array
+payloads are packed with the native ``gatherv``, streamed through the
+native ring queue to the file writer, and crc32c-checksummed; ``maybe_load``
+verifies integrity and falls back — rank-coordinated — to an older
+generation when a snapshot is corrupt.
 """
 
 from __future__ import annotations
@@ -30,13 +36,29 @@ from __future__ import annotations
 import os
 import pickle
 import re
+import struct
 import threading
+import warnings
 from typing import Any, Optional, Tuple
 
 import jax
 import numpy as np
 
 from chainermn_tpu.communicators.base import CommunicatorBase
+from chainermn_tpu.utils import native
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A snapshot file failed integrity verification (crc32c mismatch,
+    truncation, or unparseable contents)."""
+
+
+class _ArrayRef:
+    """Header placeholder for an ndarray whose bytes live in the payload
+    section (plain class, not NamedTuple: must be a pytree *leaf*)."""
+
+    def __init__(self, idx: int):
+        self.idx = idx
 
 
 class _ShardList:
@@ -107,6 +129,188 @@ def _restore_leaf(tpl, saved):
     return arr.astype(getattr(tpl, "dtype", arr.dtype))
 
 
+# ---------------------------------------------------------------------------
+# Framed snapshot format (v2) — the native-component seam.
+#
+# Layout:  MAGIC | u64 header_len | u32 header_crc32c | header pickle
+#          | payload | u32 payload_crc32c
+#
+# The header pickles the state tree with every ndarray replaced by an
+# _ArrayRef into the payload section (shapes/dtypes recorded alongside);
+# the payload is the concatenation of the raw array bytes.  Writing
+# packs arrays into chunks with the native ``gatherv``
+# (csrc/hostbuf.cpp) and streams them through the native ring queue to a
+# file-writer thread, overlapping the parallel memcpy + crc32c with disk
+# I/O — the pinned-staging double-buffering idea of the reference's
+# ``_memory_utility``/``HostPinnedMemory`` applied to checkpointing.
+# Reading verifies the crc32c before any bytes are trusted and scatters
+# the payload back into preallocated arrays with ``scatterv``.
+# ---------------------------------------------------------------------------
+
+_MAGIC = b"CMNTPU02"
+_CHUNK_BYTES = 8 << 20
+
+
+def _split_payload(host_tree):
+    """Replace ndarray leaves (incl. inside _ShardList) with _ArrayRef
+    placeholders; return (struct_tree, buffers)."""
+    buffers: list[np.ndarray] = []
+
+    def add(a: np.ndarray):
+        # order="C" (not ascontiguousarray, which promotes 0-d to (1,)).
+        buffers.append(np.asarray(a, order="C"))
+        return _ArrayRef(len(buffers) - 1)
+
+    def conv(x):
+        if isinstance(x, _ShardList):
+            return _ShardList(
+                [add(s) if _bufferable(s) else s for s in x.shards],
+                x.indices,
+            )
+        if _bufferable(x):
+            return add(x)
+        return x
+
+    struct_tree = jax.tree.map(
+        conv, host_tree, is_leaf=lambda x: isinstance(x, _ShardList)
+    )
+    return struct_tree, buffers
+
+
+def _bufferable(x) -> bool:
+    return isinstance(x, np.ndarray) and x.dtype != object
+
+
+def _join_payload(struct_tree, arrays):
+    def conv(x):
+        if isinstance(x, _ArrayRef):
+            return arrays[x.idx]
+        if isinstance(x, _ShardList):
+            return _ShardList(
+                [arrays[s.idx] if isinstance(s, _ArrayRef) else s
+                 for s in x.shards],
+                x.indices,
+            )
+        return x
+
+    return jax.tree.map(
+        conv, struct_tree,
+        is_leaf=lambda x: isinstance(x, (_ArrayRef, _ShardList)),
+    )
+
+
+def _chunk_groups(buffers):
+    """Group buffer indices into ~_CHUNK_BYTES packing units (one oversized
+    buffer forms its own unit).  Zero-size buffers are skipped: they add no
+    payload bytes, and an empty push would mimic the queue-close sentinel
+    in the writer."""
+    group, group_bytes = [], 0
+    for i, a in enumerate(buffers):
+        if a.nbytes == 0:
+            continue
+        if group and group_bytes + a.nbytes > _CHUNK_BYTES:
+            yield group
+            group, group_bytes = [], 0
+        group.append(i)
+        group_bytes += a.nbytes
+    if group:
+        yield group
+
+
+def _write_snapshot(path: str, host_tree) -> None:
+    struct_tree, buffers = _split_payload(host_tree)
+    header = pickle.dumps(
+        {
+            "struct": struct_tree,
+            "buffers": [(a.shape, a.dtype.str) for a in buffers],
+            "payload_len": int(sum(a.nbytes for a in buffers)),
+        },
+        protocol=pickle.HIGHEST_PROTOCOL,
+    )
+    q = native.NativeQueue(capacity=4)
+    max_chunk = max(
+        [_CHUNK_BYTES] + [a.nbytes for a in buffers]
+    )
+    result: dict = {}
+
+    def writer():
+        try:
+            with open(path, "wb") as f:
+                f.write(_MAGIC)
+                f.write(struct.pack("<QI", len(header),
+                                    native.crc32c(header)))
+                f.write(header)
+                crc = 0
+                while True:
+                    chunk = q.pop(max_chunk)
+                    if not chunk:
+                        break
+                    crc = native.crc32c(chunk, crc)
+                    f.write(chunk)
+                f.write(struct.pack("<I", crc))
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+            result["error"] = e
+            q.close()  # unblock a producer waiting on a full queue
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        for group in _chunk_groups(buffers):
+            packed = native.pack_buffers([buffers[i] for i in group])
+            if not q.push(packed.tobytes()):
+                break  # writer died and closed the queue
+    finally:
+        q.close()
+        t.join()
+    if "error" in result:
+        raise result["error"]
+
+
+def _read_snapshot(path: str):
+    """Parse one snapshot file; raises CheckpointCorruptionError on any
+    integrity failure.  Legacy (pre-v2, plain pickle) files load too."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        raise CheckpointCorruptionError(f"{path}: unreadable: {e}") from e
+    if data[: len(_MAGIC)] != _MAGIC:
+        try:
+            return pickle.loads(data)  # legacy format (no integrity info)
+        except Exception as e:
+            raise CheckpointCorruptionError(
+                f"{path}: not a v2 snapshot and not a legacy pickle"
+            ) from e
+    try:
+        off = len(_MAGIC)
+        hlen, hcrc_stored = struct.unpack_from("<QI", data, off)
+        off += 12
+        header_bytes = data[off : off + hlen]
+        if len(header_bytes) != hlen or native.crc32c(header_bytes) != hcrc_stored:
+            raise CheckpointCorruptionError(
+                f"{path}: header crc32c mismatch — snapshot is corrupt"
+            )
+        header = pickle.loads(header_bytes)
+        off += hlen
+        plen = header["payload_len"]
+        payload = np.frombuffer(data, np.uint8, count=plen, offset=off)
+        (crc_stored,) = struct.unpack_from("<I", data, off + plen)
+    except CheckpointCorruptionError:
+        raise
+    except Exception as e:
+        raise CheckpointCorruptionError(f"{path}: truncated or garbled") from e
+    if native.crc32c(payload) != crc_stored:
+        raise CheckpointCorruptionError(
+            f"{path}: payload crc32c mismatch — snapshot is corrupt"
+        )
+    arrays = [
+        np.empty(shape, np.dtype(dt)) for shape, dt in header["buffers"]
+    ]
+    if arrays:
+        native.unpack_buffers(payload, arrays)
+    return _join_payload(header["struct"], arrays)
+
+
 class MultiNodeCheckpointer:
     def __init__(
         self,
@@ -160,8 +364,7 @@ class MultiNodeCheckpointer:
 
         def write():
             tmp = self._snap(iteration, rank) + ".tmp"
-            with open(tmp, "wb") as f:
-                pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
+            _write_snapshot(tmp, host_state)
             os.replace(tmp, self._snap(iteration, rank))
             with open(self._marker(iteration, rank), "w") as f:
                 f.write("ok")
@@ -283,20 +486,48 @@ class MultiNodeCheckpointer:
         With a ``state`` template, every leaf is restored at the
         template's dtype AND placement: replicated/sharded jax Arrays come
         back with the template's sharding (shard-list leaves are
-        re-assembled onto the template's addressable devices)."""
+        re-assembled onto the template's addressable devices).
+
+        Integrity: every snapshot verifies its crc32c before any byte is
+        trusted.  A corrupt newest generation falls back (with a warning)
+        to the next older consistent one — *agreed across ranks*, so a
+        generation corrupt on any single rank is skipped by all.  If every
+        consistent generation is corrupt this raises rather than silently
+        restarting from scratch."""
         self.wait()
         done = self._consistent_generations()
         if not done:
             return state, None
-        it = done[-1]
-        with open(self._snap(it, self.comm.rank), "rb") as f:
-            loaded = pickle.load(f)
-        if state is not None:
-            loaded = jax.tree.map(
-                _restore_leaf, state, loaded,
-                is_leaf=lambda x: isinstance(x, _ShardList),
+        last_err: Optional[BaseException] = None
+        for it in reversed(done):
+            try:
+                loaded = _read_snapshot(self._snap(it, self.comm.rank))
+                ok = 1
+            except CheckpointCorruptionError as e:
+                loaded, ok, last_err = None, 0, e
+            # All ranks must restore the same generation: one rank's
+            # corruption vetoes the generation everywhere.
+            ok_everywhere = (
+                bool(ok) if self.comm.size == 1
+                else self.comm.allreduce_obj(ok) == self.comm.size
             )
-        return loaded, it
+            if not ok_everywhere:
+                warnings.warn(
+                    f"checkpoint generation {it} is corrupt on at least one "
+                    f"rank ({last_err}); falling back to an older generation"
+                )
+                continue
+            if state is not None:
+                loaded = jax.tree.map(
+                    _restore_leaf, state, loaded,
+                    is_leaf=lambda x: isinstance(x, _ShardList),
+                )
+            return loaded, it
+        raise CheckpointCorruptionError(
+            f"all consistent checkpoint generations {done} failed "
+            f"integrity verification; refusing to silently restart "
+            f"from scratch"
+        ) from last_err
 
 
 def create_multi_node_checkpointer(

@@ -13,7 +13,6 @@ import ctypes
 import os
 import subprocess
 import threading
-import zlib
 from typing import Optional, Sequence
 
 import numpy as np
@@ -61,10 +60,14 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.hostbuf_crc32c.argtypes = [
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32,
         ]
-        lib.hostbuf_parallel_gather.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
-            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
-        ]
+        for name in ("hostbuf_gatherv", "hostbuf_scatterv"):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_uint64, ctypes.c_int,
+            ]
         lib.hostbuf_queue_new.restype = ctypes.c_void_p
         lib.hostbuf_queue_new.argtypes = [ctypes.c_uint64]
         lib.hostbuf_queue_push.restype = ctypes.c_int
@@ -83,37 +86,141 @@ def get_lib() -> Optional[ctypes.CDLL]:
         return _lib
 
 
-def crc32c(data: bytes, seed: int = 0) -> int:
-    """CRC32C checksum (native; zlib.crc32 fallback keeps determinism per
-    process, flagged by a different polynomial)."""
+_CRC32C_TABLE: Optional[list] = None
+
+
+def _crc32c_py(data, seed: int) -> int:
+    """Pure-Python CRC32C (Castagnoli), bit-identical to the native one —
+    the checksum is load-bearing (checkpoint accept/reject, cross-host
+    collective fingerprints), so the fallback must match the native
+    polynomial exactly, not substitute zlib's."""
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        poly = 0x82F63B78
+        table = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ (poly if crc & 1 else 0)
+            table.append(crc)
+        _CRC32C_TABLE = table
+    crc = ~seed & 0xFFFFFFFF
+    tab = _CRC32C_TABLE
+    for b in memoryview(data).cast("B"):
+        crc = (crc >> 8) ^ tab[(crc ^ b) & 0xFF]
+    return ~crc & 0xFFFFFFFF
+
+
+def crc32c(data, seed: int = 0) -> int:
+    """CRC32C checksum over ``bytes`` or a C-contiguous ``np.ndarray``
+    (arrays are checksummed in place via their buffer pointer — no copy).
+    Native implementation with a bit-identical pure-Python fallback."""
+    lib = get_lib()
+    if isinstance(data, np.ndarray):
+        if not data.flags["C_CONTIGUOUS"]:
+            data = np.ascontiguousarray(data)
+        if lib is None:
+            return _crc32c_py(data.view(np.uint8).ravel(), seed)
+        return int(
+            lib.hostbuf_crc32c(
+                data.ctypes.data_as(ctypes.c_char_p), data.nbytes, seed
+            )
+        )
+    if lib is None:
+        return _crc32c_py(data, seed)
+    return int(lib.hostbuf_crc32c(data, len(data), seed))
+
+
+def _default_threads(n_threads: int) -> int:
+    if n_threads <= 0:
+        return min(8, os.cpu_count() or 1)
+    return n_threads
+
+
+def _as_u64_array(vals) -> "ctypes.Array":
+    return (ctypes.c_uint64 * len(vals))(*vals)
+
+
+def _ptr_array(arrays: Sequence[np.ndarray]) -> "ctypes.Array":
+    return (ctypes.c_void_p * len(arrays))(
+        *[a.ctypes.data_as(ctypes.c_void_p) for a in arrays]
+    )
+
+
+def pack_buffers(
+    arrays: Sequence[np.ndarray],
+    out: Optional[np.ndarray] = None,
+    n_threads: int = 0,
+) -> np.ndarray:
+    """Concatenate the raw bytes of C-contiguous arrays (any shapes/dtypes)
+    into one uint8 buffer with a native multithreaded memcpy — pack_params
+    for the host side.  Used by the checkpoint writer to assemble payload
+    chunks."""
+    # np.asarray(..., order="C") rather than ascontiguousarray: the latter
+    # silently promotes 0-d arrays to shape (1,).
+    arrays = [np.asarray(a, order="C") for a in arrays]
+    sizes = [a.nbytes for a in arrays]
+    total = sum(sizes)
+    if out is None:
+        out = np.empty(total, np.uint8)
+    elif out.nbytes < total:
+        raise ValueError(f"pack_buffers out ({out.nbytes}) < total ({total})")
+    lib = get_lib()
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+    if lib is None:
+        view = out.view(np.uint8)
+        for a, off, sz in zip(arrays, offsets, sizes):
+            view[off : off + sz] = a.view(np.uint8).ravel()
+        return out
+    lib.hostbuf_gatherv(
+        out.ctypes.data_as(ctypes.c_void_p), _ptr_array(arrays),
+        _as_u64_array(sizes), _as_u64_array(offsets),
+        len(arrays), _default_threads(n_threads),
+    )
+    return out
+
+
+def unpack_buffers(
+    buf: np.ndarray, arrays: Sequence[np.ndarray], n_threads: int = 0
+) -> None:
+    """Scatter a contiguous uint8 buffer back into preallocated
+    C-contiguous arrays (unpack_params) — the checkpoint loader's inverse
+    of :func:`pack_buffers`."""
+    sizes = [a.nbytes for a in arrays]
+    total = sum(sizes)
+    if buf.nbytes < total:
+        raise ValueError(f"unpack_buffers buf ({buf.nbytes}) < total ({total})")
+    for a in arrays:
+        if not a.flags["C_CONTIGUOUS"]:
+            raise ValueError("unpack_buffers targets must be C-contiguous")
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
     lib = get_lib()
     if lib is None:
-        return zlib.crc32(data, seed) & 0xFFFFFFFF
-    return int(lib.hostbuf_crc32c(data, len(data), seed))
+        view = buf.view(np.uint8)
+        for a, off, sz in zip(arrays, offsets, sizes):
+            a.view(np.uint8).ravel()[:] = view[off : off + sz]
+        return
+    lib.hostbuf_scatterv(
+        buf.ctypes.data_as(ctypes.c_void_p), _ptr_array(arrays),
+        _as_u64_array(sizes), _as_u64_array(offsets),
+        len(arrays), _default_threads(n_threads),
+    )
 
 
 def parallel_gather(items: Sequence[np.ndarray], n_threads: int = 0) -> np.ndarray:
     """Stack equal-shaped C-contiguous arrays into one batch array with a
     native multithreaded memcpy — the pack_params idea where it still pays
-    on TPU hosts (np.stack is GIL-bound)."""
-    items = [np.ascontiguousarray(a) for a in items]
-    first = items[0]
+    on TPU hosts (np.stack is GIL-bound).  The batch-assembly path of
+    ``datasets.toy.batch_iterator`` (all examples feed through it)."""
+    first = np.asarray(items[0], order="C")
+    if any(
+        np.shape(a) != first.shape or np.asarray(a).dtype != first.dtype
+        for a in items[1:]
+    ):
+        raise ValueError("parallel_gather needs equal-shaped same-dtype items")
     out = np.empty((len(items),) + first.shape, first.dtype)
-    lib = get_lib()
-    if lib is None:
-        for i, a in enumerate(items):
-            out[i] = a
-        return out
-    item_size = first.nbytes
-    ptrs = (ctypes.c_void_p * len(items))(
-        *[a.ctypes.data_as(ctypes.c_void_p) for a in items]
-    )
-    if n_threads <= 0:
-        n_threads = min(8, os.cpu_count() or 1)
-    lib.hostbuf_parallel_gather(
-        out.ctypes.data_as(ctypes.c_void_p), ptrs,
-        len(items), item_size, n_threads,
-    )
+    pack_buffers(items, out=out.reshape(-1).view(np.uint8),
+                 n_threads=n_threads)
     return out
 
 

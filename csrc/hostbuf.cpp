@@ -7,16 +7,19 @@
 // XLA owns device memory and the collectives, so the native seam moves to
 // the host side of the pipeline, where Python is the bottleneck:
 //
-//   * parallel_gather — assemble N dataset items into one contiguous batch
-//     buffer with a thread pool (the pack_params idea applied where it still
-//     matters: batch assembly is memcpy-bound and numpy's np.stack is
+//   * gatherv/scatterv — pack N (possibly ragged) host buffers into one
+//     contiguous buffer and back with a thread pool (the pack_params idea
+//     applied where it still matters: batch assembly in
+//     datasets.toy.batch_iterator and checkpoint payload packing in
+//     extensions.checkpoint are memcpy-bound, and numpy copies are
 //     single-threaded under the GIL; ctypes releases the GIL for the whole
 //     call).
-//   * crc32c — checksums for checkpoint shard integrity and the
-//     collective-order debug mode (SURVEY §5.2).
-//   * a ring queue — bounded MPMC byte-buffer queue for the prefetch
-//     pipeline (the host-staging analogue of HostPinnedMemory's double
-//     buffering).
+//   * crc32c — checkpoint shard integrity (written at save, verified at
+//     load, extensions/checkpoint.py) and the collective-order debug mode
+//     (SURVEY §5.2, utils/debug.py).
+//   * a ring queue — bounded MPMC byte-buffer queue; stages the checkpoint
+//     payload chunks between the packing thread and the file-writer thread
+//     (the host-staging analogue of HostPinnedMemory's double buffering).
 //
 // Built with: g++ -O3 -march=native -shared -fPIC -o libhostbuf.so hostbuf.cpp -lpthread
 
@@ -24,6 +27,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <functional>
 #include <mutex>
 #include <queue>
 #include <thread>
@@ -60,14 +64,21 @@ uint32_t hostbuf_crc32c(const uint8_t* data, uint64_t len, uint32_t seed) {
 }
 
 // ---------------------------------------------------------------------------
-// parallel_gather: dst[i*item_size : (i+1)*item_size] = *srcs[i]
+// gatherv / scatterv: pack N variable-size source buffers into a contiguous
+// destination at caller-computed offsets, and the inverse.  The pack_params/
+// unpack_params idea (REF:chainermn/communicators/_memory_utility.py) applied
+// where it still pays on a TPU host: batch assembly (equal sizes) and
+// checkpoint payload packing (ragged leaf sizes) are memcpy-bound, and numpy
+// copies run single-threaded under the GIL while ctypes releases it for the
+// whole call.
 // ---------------------------------------------------------------------------
-void hostbuf_parallel_gather(uint8_t* dst, const uint8_t** srcs,
-                             uint64_t n_items, uint64_t item_size,
-                             int n_threads) {
-  if (n_threads <= 1 || n_items < 4) {
-    for (uint64_t i = 0; i < n_items; i++)
-      std::memcpy(dst + i * item_size, srcs[i], item_size);
+static void run_copies(uint64_t n_items, int n_threads,
+                       const std::function<void(uint64_t)>& copy_one,
+                       uint64_t total_bytes) {
+  // Threading only pays past ~1 MiB of copies; below that, pool start-up
+  // dominates.
+  if (n_threads <= 1 || n_items < 2 || total_bytes < (1u << 20)) {
+    for (uint64_t i = 0; i < n_items; i++) copy_one(i);
     return;
   }
   std::vector<std::thread> pool;
@@ -77,35 +88,33 @@ void hostbuf_parallel_gather(uint8_t* dst, const uint8_t** srcs,
       for (;;) {
         uint64_t i = next.fetch_add(1);
         if (i >= n_items) return;
-        std::memcpy(dst + i * item_size, srcs[i], item_size);
+        copy_one(i);
       }
     });
   }
   for (auto& th : pool) th.join();
 }
 
-// Scatter is the inverse (unpack_params analogue): contiguous buffer out to
-// per-item destinations.
-void hostbuf_parallel_scatter(const uint8_t* src, uint8_t** dsts,
-                              uint64_t n_items, uint64_t item_size,
-                              int n_threads) {
-  if (n_threads <= 1 || n_items < 4) {
-    for (uint64_t i = 0; i < n_items; i++)
-      std::memcpy(dsts[i], src + i * item_size, item_size);
-    return;
-  }
-  std::vector<std::thread> pool;
-  std::atomic<uint64_t> next{0};
-  for (int t = 0; t < n_threads; t++) {
-    pool.emplace_back([&]() {
-      for (;;) {
-        uint64_t i = next.fetch_add(1);
-        if (i >= n_items) return;
-        std::memcpy(dsts[i], src + i * item_size, item_size);
-      }
-    });
-  }
-  for (auto& th : pool) th.join();
+void hostbuf_gatherv(uint8_t* dst, const uint8_t** srcs,
+                     const uint64_t* sizes, const uint64_t* offsets,
+                     uint64_t n_items, int n_threads) {
+  uint64_t total = 0;
+  for (uint64_t i = 0; i < n_items; i++) total += sizes[i];
+  run_copies(
+      n_items, n_threads,
+      [&](uint64_t i) { std::memcpy(dst + offsets[i], srcs[i], sizes[i]); },
+      total);
+}
+
+void hostbuf_scatterv(const uint8_t* src, uint8_t** dsts,
+                      const uint64_t* sizes, const uint64_t* offsets,
+                      uint64_t n_items, int n_threads) {
+  uint64_t total = 0;
+  for (uint64_t i = 0; i < n_items; i++) total += sizes[i];
+  run_copies(
+      n_items, n_threads,
+      [&](uint64_t i) { std::memcpy(dsts[i], src + offsets[i], sizes[i]); },
+      total);
 }
 
 // ---------------------------------------------------------------------------

@@ -25,6 +25,45 @@ def test_parallel_gather_matches_stack():
     np.testing.assert_array_equal(out, np.stack(items))
 
 
+def test_parallel_gather_rejects_mismatch():
+    with pytest.raises(ValueError, match="equal-shaped"):
+        native.parallel_gather([np.zeros((2, 2)), np.zeros((2, 3))])
+
+
+def test_pack_unpack_ragged_roundtrip():
+    """gatherv/scatterv over ragged shapes+dtypes (the checkpoint payload
+    shape): bytes concatenate exactly and scatter back bit-identical."""
+    rng = np.random.RandomState(0)
+    arrays = [
+        rng.randn(3, 5).astype(np.float32),
+        rng.randint(0, 100, size=(7,)).astype(np.int64),
+        np.float64(rng.randn()) * np.ones(()),
+        rng.randn(2, 2, 2).astype(np.float16),
+    ]
+    buf = native.pack_buffers(arrays)
+    assert buf.nbytes == sum(a.nbytes for a in arrays)
+    # Byte-exact layout: manual concatenation agrees.
+    manual = np.concatenate(
+        [np.ascontiguousarray(a).view(np.uint8).ravel() for a in arrays]
+    )
+    np.testing.assert_array_equal(buf, manual)
+    outs = [np.empty_like(a) for a in arrays]
+    native.unpack_buffers(buf, outs)
+    for a, o in zip(arrays, outs):
+        np.testing.assert_array_equal(a, o)
+
+
+def test_crc32c_incremental_chaining():
+    """Streaming crc (seed chaining) equals one-shot crc — the checkpoint
+    writer relies on this across payload chunks."""
+    data = np.random.RandomState(1).bytes(100_000)
+    one = native.crc32c(data)
+    acc = 0
+    for i in range(0, len(data), 33_333):
+        acc = native.crc32c(data[i : i + 33_333], acc)
+    assert acc == one
+
+
 def test_native_queue_roundtrip():
     q = native.NativeQueue(capacity=2)
     assert q.push(b"hello")
