@@ -268,37 +268,47 @@ def _write_snapshot(path: str, host_tree) -> None:
 
 def _read_snapshot(path: str):
     """Parse one snapshot file; raises CheckpointCorruptionError on any
-    integrity failure.  Legacy (pre-v2, plain pickle) files load too."""
+    integrity failure.  Legacy (pre-v2, plain pickle) files load too.
+
+    The payload is read directly into one preallocated buffer (no whole-
+    file bytes object alongside it), keeping peak load memory at payload +
+    destination arrays — the read-side counterpart of the chunked writer.
+    """
     try:
-        with open(path, "rb") as f:
-            data = f.read()
+        f = open(path, "rb")
     except OSError as e:
         raise CheckpointCorruptionError(f"{path}: unreadable: {e}") from e
-    if data[: len(_MAGIC)] != _MAGIC:
+    with f:
+        prefix = f.read(len(_MAGIC))
+        if prefix != _MAGIC:
+            try:
+                return pickle.loads(prefix + f.read())  # legacy pickle
+            except Exception as e:
+                raise CheckpointCorruptionError(
+                    f"{path}: not a v2 snapshot and not a legacy pickle"
+                ) from e
         try:
-            return pickle.loads(data)  # legacy format (no integrity info)
+            hlen, hcrc_stored = struct.unpack("<QI", f.read(12))
+            header_bytes = f.read(hlen)
+            if (
+                len(header_bytes) != hlen
+                or native.crc32c(header_bytes) != hcrc_stored
+            ):
+                raise CheckpointCorruptionError(
+                    f"{path}: header crc32c mismatch — snapshot is corrupt"
+                )
+            header = pickle.loads(header_bytes)
+            plen = header["payload_len"]
+            payload = np.empty(plen, np.uint8)
+            if f.readinto(memoryview(payload)) != plen:
+                raise CheckpointCorruptionError(f"{path}: payload truncated")
+            (crc_stored,) = struct.unpack("<I", f.read(4))
+        except CheckpointCorruptionError:
+            raise
         except Exception as e:
             raise CheckpointCorruptionError(
-                f"{path}: not a v2 snapshot and not a legacy pickle"
+                f"{path}: truncated or garbled"
             ) from e
-    try:
-        off = len(_MAGIC)
-        hlen, hcrc_stored = struct.unpack_from("<QI", data, off)
-        off += 12
-        header_bytes = data[off : off + hlen]
-        if len(header_bytes) != hlen or native.crc32c(header_bytes) != hcrc_stored:
-            raise CheckpointCorruptionError(
-                f"{path}: header crc32c mismatch — snapshot is corrupt"
-            )
-        header = pickle.loads(header_bytes)
-        off += hlen
-        plen = header["payload_len"]
-        payload = np.frombuffer(data, np.uint8, count=plen, offset=off)
-        (crc_stored,) = struct.unpack_from("<I", data, off + plen)
-    except CheckpointCorruptionError:
-        raise
-    except Exception as e:
-        raise CheckpointCorruptionError(f"{path}: truncated or garbled") from e
     if native.crc32c(payload) != crc_stored:
         raise CheckpointCorruptionError(
             f"{path}: payload crc32c mismatch — snapshot is corrupt"
@@ -496,6 +506,13 @@ class MultiNodeCheckpointer:
         restarting from scratch."""
         self.wait()
         done = self._consistent_generations()
+        # The per-generation integrity votes below are collectives, so all
+        # ranks must iterate the SAME generation list: one rank listing a
+        # marker before another (async saves, NFS attribute caching) would
+        # otherwise desynchronize the votes.  Agree on the intersection.
+        if self.comm.size > 1:
+            lists = self.comm.allgather_obj(set(done))
+            done = sorted(set.intersection(*map(set, lists)))
         if not done:
             return state, None
         last_err: Optional[BaseException] = None

@@ -102,10 +102,6 @@ class MultiNodeOptimizer:
         self.double_buffering = double_buffering
         if zero_stage not in (0, 1, 2, 3):
             raise ValueError("zero_stage must be 0, 1, 2 or 3")
-        if zero_stage > 0 and double_buffering:
-            raise NotImplementedError(
-                "double_buffering + zero_stage>0 not supported together"
-            )
         self.zero_stage = zero_stage
         # ZeRO-3 pack metadata: (treedef, [(shape, dtype, size)]) captured by
         # shard_params/init so the flat buffer can be unpacked without the
@@ -132,7 +128,16 @@ class MultiNodeOptimizer:
             inner = self._zero_init(params)
         else:
             inner = self.actual_optimizer.init(params)
-        zeros = jax.tree.map(jnp.zeros_like, params) if self.double_buffering else ()
+        if not self.double_buffering:
+            zeros = ()
+        elif self.zero_stage > 0:
+            # Stale means live as the 1/n fp32 gradient shard — double
+            # buffering costs shard-sized memory under ZeRO, not a full
+            # gradient tree.
+            n, _, shard_size = self._zero_geometry(params)
+            zeros = jnp.zeros((shard_size * n,), jnp.float32)
+        else:
+            zeros = jax.tree.map(jnp.zeros_like, params)
         return MultiNodeOptimizerState(
             inner=inner,
             step=jnp.zeros((), jnp.int32),
@@ -383,6 +388,75 @@ class MultiNodeOptimizer:
             inner=inner, step=state.step + 1, comm_buf=()
         )
 
+    def _apply_shard_update(self, pshard, state, gshard, loss_scale=None):
+        """The ZeRO analogue of :meth:`_apply_update`: apply a gradient
+        *shard* to the local parameter shard.  With ``double_buffering``
+        the stale shard in ``comm_buf`` is applied (skipping step 0) and
+        this step's ``gshard`` is stored for the next — identical staleness
+        semantics to stage 0, at 1/n the buffer memory.  Scaled gradients
+        are unscaled exactly once, at application time."""
+        opt = self.actual_optimizer
+        if self.double_buffering:
+
+            def do_update(operand):
+                pshard, inner, stale = operand
+                if loss_scale is not None:
+                    stale = stale / loss_scale
+                updates, inner = opt.update(stale, inner, pshard)
+                return optax.apply_updates(pshard, updates), inner
+
+            pshard, inner = lax.cond(
+                state.step > 0,
+                do_update,
+                lambda operand: (operand[0], operand[1]),
+                (pshard, state.inner, state.comm_buf),
+            )
+            new_state = MultiNodeOptimizerState(
+                inner=inner, step=state.step + 1, comm_buf=gshard
+            )
+            return pshard, new_state
+        if loss_scale is not None:
+            gshard = gshard / loss_scale
+        updates, inner = opt.update(gshard, state.inner, pshard)
+        pshard = optax.apply_updates(pshard, updates)
+        return pshard, MultiNodeOptimizerState(
+            inner=inner, step=state.step + 1, comm_buf=()
+        )
+
+    def _zero_param_update(
+        self, params, state, gshard, shard_size, n, loss_scale=None
+    ):
+        """The ZeRO-1/2 parameter tail shared by the stateless and
+        with-model-state steps: pack params → take the local shard → apply
+        the (possibly stale) gradient shard → all-gather → unpack at the
+        original dtypes."""
+        comm = self.communicator
+        world = self._world_axis()
+        pflat, unpack = self._zero_pack(params, shard_size * n)
+        pshard = lax.dynamic_slice_in_dim(
+            pflat, comm.axis_index() * shard_size, shard_size
+        )
+        pshard, new_state = self._apply_shard_update(
+            pshard, state, gshard, loss_scale
+        )
+        pfull = lax.all_gather(pshard, world, axis=0, tiled=True)
+        new_params = unpack(pfull[: shard_size * n])
+        new_params = jax.tree.map(
+            lambda x, ref: x.astype(ref.dtype), new_params, params
+        )
+        return new_params, new_state
+
+    def _zero_state_spec(self, shard_size):
+        """The MultiNodeOptimizerState PartitionSpec for ZeRO steps: inner
+        state sharded over the world, comm_buf likewise when double
+        buffering holds the stale gradient shard."""
+        world = self._world_axis()
+        return MultiNodeOptimizerState(
+            inner=self._zero_inner_spec(shard_size),
+            step=P(),
+            comm_buf=P(world) if self.double_buffering else (),
+        )
+
     def make_train_step(
         self,
         loss_fn: Callable,
@@ -521,7 +595,6 @@ class MultiNodeOptimizer:
         comm = self.communicator
         axes = comm.axes
         world = self._world_axis()
-        opt = self.actual_optimizer
         one = self._make_micro_grad_fn(loss_fn, has_aux, loss_scale)
         per_micro_scatter = self.zero_stage == 2 and n_accum > 1
 
@@ -539,22 +612,8 @@ class MultiNodeOptimizer:
                 )
                 gshard = self._scatter_grads(grads, shard_size, n, world)
             loss = lax.pmean(loss, axes)
-            if loss_scale is not None:
-                gshard = gshard / loss_scale
-
-            pflat, unpack = self._zero_pack(params, shard_size * n)
-            pshard = lax.dynamic_slice_in_dim(
-                pflat, comm.axis_index() * shard_size, shard_size
-            )
-            updates, inner = opt.update(gshard, state.inner, pshard)
-            pshard = optax.apply_updates(pshard, updates)
-            pfull = lax.all_gather(pshard, world, axis=0, tiled=True)
-            new_params = unpack(pfull[: shard_size * n])
-            new_params = jax.tree.map(
-                lambda x, ref: x.astype(ref.dtype), new_params, params
-            )
-            new_state = MultiNodeOptimizerState(
-                inner=inner, step=state.step + 1, comm_buf=()
+            new_params, new_state = self._zero_param_update(
+                params, state, gshard, shard_size, n, loss_scale
             )
             if has_aux:
                 return new_params, new_state, loss, aux
@@ -564,9 +623,7 @@ class MultiNodeOptimizer:
         # spec lazily at first call via closure over the real params.
         def make(params_example):
             n, total, shard = self._zero_geometry(params_example)
-            state_spec = MultiNodeOptimizerState(
-                inner=self._zero_inner_spec(shard), step=P(), comm_buf=(),
-            )
+            state_spec = self._zero_state_spec(shard)
             n_out = 4 if has_aux else 3
             mapped = comm.shard_map(
                 body,
@@ -610,7 +667,6 @@ class MultiNodeOptimizer:
         comm = self.communicator
         axes = comm.axes
         world = self._world_axis()
-        opt = self.actual_optimizer
         one = self._make_micro_grad_fn(loss_fn, has_aux, loss_scale)
 
         def body(pshard, state, batch):
@@ -623,13 +679,8 @@ class MultiNodeOptimizer:
                 one, params, batch, base_key, n_accum, shard_size, n, world
             )
             loss = lax.pmean(loss, axes)
-            if loss_scale is not None:
-                gshard = gshard / loss_scale
-
-            updates, inner = opt.update(gshard, state.inner, pshard)
-            new_pshard = optax.apply_updates(pshard, updates)
-            new_state = MultiNodeOptimizerState(
-                inner=inner, step=state.step + 1, comm_buf=()
+            new_pshard, new_state = self._apply_shard_update(
+                pshard, state, gshard, loss_scale
             )
             if has_aux:
                 return new_pshard, new_state, loss, aux
@@ -637,9 +688,7 @@ class MultiNodeOptimizer:
 
         def make(flat_example):
             shard = flat_example.shape[0] // comm.device_size
-            state_spec = MultiNodeOptimizerState(
-                inner=self._zero_inner_spec(shard), step=P(), comm_buf=(),
-            )
+            state_spec = self._zero_state_spec(shard)
             n_out = 4 if has_aux else 3
             mapped = comm.shard_map(
                 body,
@@ -690,19 +739,19 @@ class MultiNodeOptimizer:
         (BatchNorm statistics) always updates from the CURRENT step —
         statistics are running estimates, not gradients, so staleness
         semantics do not apply to them.
+
+        ZeRO works here too: stages 1/2 keep the pytree step signature with
+        the optimizer state sharded; stage 3 takes/returns the flat sharded
+        master buffer in place of the params pytree (as
+        :meth:`make_train_step` does) — ``step(flat_params, opt_state,
+        model_state, batch)``.
         """
-        if self.zero_stage > 0:
-            raise NotImplementedError(
-                "make_train_step_with_state does not support zero_stage>0 "
-                "yet; use make_train_step (stateless loss) with ZeRO"
-            )
         comm = self.communicator
         axes = comm.axes
         if batch_spec is None:
             batch_spec = P(axes if len(axes) > 1 else axes[0])
-        opt = self.actual_optimizer
 
-        def body(params, state, model_state, batch):
+        def grads_and_state(params, model_state, batch):
             (loss, new_model_state), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params, model_state, batch)
@@ -712,6 +761,17 @@ class MultiNodeOptimizer:
                 if jnp.issubdtype(x.dtype, jnp.floating)
                 else x,
                 new_model_state,
+            )
+            return loss, new_model_state, grads
+
+        if self.zero_stage > 0:
+            return self._make_zero_with_state_step(
+                grads_and_state, batch_spec, donate
+            )
+
+        def body(params, state, model_state, batch):
+            loss, new_model_state, grads = grads_and_state(
+                params, model_state, batch
             )
             params, new_state = self._apply_update(params, state, grads)
             return params, new_state, new_model_state, loss
@@ -723,6 +783,93 @@ class MultiNodeOptimizer:
         )
         donate_argnums = (0, 1, 2) if donate else ()
         return jax.jit(mapped, donate_argnums=donate_argnums)
+
+    def _make_zero_with_state_step(self, grads_and_state, batch_spec, donate):
+        """ZeRO tails for the with-model-state step.  Stages 1/2 are
+        identical here (stage 2's distinct behavior only exists under
+        gradient accumulation, which the with-state surface does not
+        expose); stage 3 trades the pytree for the flat sharded buffer."""
+        comm = self.communicator
+        world = self._world_axis()
+
+        if self.zero_stage in (1, 2):
+
+            def body(params, state, model_state, batch):
+                n, total, shard_size = self._zero_geometry(params)
+                loss, new_model_state, grads = grads_and_state(
+                    params, model_state, batch
+                )
+                gshard = self._scatter_grads(grads, shard_size, n, world)
+                new_params, new_state = self._zero_param_update(
+                    params, state, gshard, shard_size, n
+                )
+                return new_params, new_state, new_model_state, loss
+
+            def make(params_example):
+                n, total, shard = self._zero_geometry(params_example)
+                state_spec = self._zero_state_spec(shard)
+                mapped = comm.shard_map(
+                    body,
+                    in_specs=(P(), state_spec, P(), batch_spec),
+                    out_specs=(P(), state_spec, P(), P()),
+                )
+                return jax.jit(
+                    mapped, donate_argnums=(0, 1, 2) if donate else ()
+                )
+
+            compiled = {}
+
+            def step(params, state, model_state, batch):
+                _check_batch_divisibility(batch, comm.device_size)
+                key = jax.tree.structure(params)
+                fn = compiled.get(key)
+                if fn is None:
+                    fn = compiled[key] = make(params)
+                return fn(params, state, model_state, batch)
+
+            return step
+
+        # zero_stage == 3: flat sharded master buffer in place of params.
+        def body3(pshard, state, model_state, batch):
+            n = comm.device_size
+            shard_size = pshard.shape[0]
+            pfull = lax.all_gather(pshard, world, axis=0, tiled=True)
+            params = self._z3_unpack(pfull)
+            loss, new_model_state, grads = grads_and_state(
+                params, model_state, batch
+            )
+            gshard = self._scatter_grads(grads, shard_size, n, world)
+            new_pshard, new_state = self._apply_shard_update(
+                pshard, state, gshard
+            )
+            return new_pshard, new_state, new_model_state, loss
+
+        def make3(flat_example):
+            shard = flat_example.shape[0] // comm.device_size
+            state_spec = self._zero_state_spec(shard)
+            mapped = comm.shard_map(
+                body3,
+                in_specs=(P(world), state_spec, P(), batch_spec),
+                out_specs=(P(world), state_spec, P(), P()),
+            )
+            return jax.jit(mapped, donate_argnums=(0, 1, 2) if donate else ())
+
+        compiled3 = {}
+
+        def step3(flat_params, state, model_state, batch):
+            if self._z3_meta is None:
+                raise RuntimeError(
+                    "zero_stage=3: call init(params) (or shard_params) first"
+                )
+            _check_batch_divisibility(batch, comm.device_size)
+            treedef, metas = self._z3_meta
+            key = (flat_params.shape, treedef, tuple(metas))
+            fn = compiled3.get(key)
+            if fn is None:
+                fn = compiled3[key] = make3(flat_params)
+            return fn(flat_params, state, model_state, batch)
+
+        return step3
 
     # ------------------------------------------------------------------
     # Imperative parity API (reference: optimizer.setup(model) + update())

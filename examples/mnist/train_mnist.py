@@ -41,6 +41,9 @@ def main():
     p.add_argument("--unit", type=int, default=1000)
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--double-buffering", action="store_true")
+    p.add_argument("--zero-stage", type=int, default=0, choices=(0, 1, 2, 3),
+                   help="ZeRO sharding stage (composes with "
+                        "--double-buffering)")
     p.add_argument("--train-size", type=int, default=8192)
     p.add_argument("--val-size", type=int, default=1024)
     args = p.parse_args()
@@ -74,9 +77,13 @@ def main():
         }
 
     opt = chainermn_tpu.create_multi_node_optimizer(
-        optax.adam(args.lr), comm, double_buffering=args.double_buffering
+        optax.adam(args.lr), comm, double_buffering=args.double_buffering,
+        zero_stage=args.zero_stage,
     )
     state = opt.init(params)
+    if args.zero_stage == 3:
+        # Stage 3: the step trades in the flat sharded master buffer.
+        params = opt.shard_params(params)
     step = opt.make_train_step(loss_fn)
     evaluator = Evaluator(metric_fn, comm)
 
@@ -91,8 +98,11 @@ def main():
         sync(last_loss)  # host readback: honest timing on all backends
         dt = time.perf_counter() - t0
 
+        eval_params = (
+            opt.materialize(params) if args.zero_stage == 3 else params
+        )
         metrics = evaluator.evaluate(
-            params, batch_iterator(val, args.batchsize, shuffle=False)
+            eval_params, batch_iterator(val, args.batchsize, shuffle=False)
         )
         if comm.rank == 0:
             ips = n_seen / dt

@@ -311,6 +311,9 @@ class _StubRankComm:
     def allreduce_obj(self, v):
         return v * self.size
 
+    def allgather_obj(self, v):
+        return [v] * self.size
+
 
 def test_checkpointer_async_cleanup_no_leak(tmp_path):
     """Async (own-rank-only) cleanup must still rotate every rank's files:

@@ -53,6 +53,36 @@ def test_pack_unpack_ragged_roundtrip():
         np.testing.assert_array_equal(a, o)
 
 
+def test_fallback_paths_handle_0d_and_match_native(monkeypatch):
+    """The no-toolchain fallbacks must handle everything the native path
+    does — including 0-d arrays (scalar labels, step counters), which
+    ndarray.view(uint8) rejects."""
+    arrays = [
+        np.asarray(np.float32(7.0)),  # 0-d
+        np.arange(6.0, dtype=np.float32).reshape(2, 3),
+        np.arange(5).astype(np.int64),
+    ]
+    native_buf = native.pack_buffers(arrays)
+    native_crc = native.crc32c(native_buf)
+
+    monkeypatch.setattr(native, "get_lib", lambda: None)
+    buf = native.pack_buffers(arrays)
+    np.testing.assert_array_equal(buf, native_buf)
+    outs = [np.empty_like(a) for a in arrays]
+    native.unpack_buffers(buf, outs)
+    for a, o in zip(arrays, outs):
+        np.testing.assert_array_equal(a, o)
+    assert native.crc32c(buf) == native_crc
+    # 0-d ndarray checksums its 4 raw bytes, same as the equivalent bytes.
+    scalar = np.asarray(np.float32(1.5))
+    assert native.crc32c(scalar) == native.crc32c(scalar.tobytes())
+    # parallel_gather fallback with scalar items (label batches).
+    labels = [np.int32(i) for i in range(5)]
+    np.testing.assert_array_equal(
+        native.parallel_gather(labels), np.arange(5, dtype=np.int32)
+    )
+
+
 def test_crc32c_incremental_chaining():
     """Streaming crc (seed chaining) equals one-shot crc — the checkpoint
     writer relies on this across payload chunks."""
