@@ -278,37 +278,49 @@ def _read_snapshot(path: str):
         f = open(path, "rb")
     except OSError as e:
         raise CheckpointCorruptionError(f"{path}: unreadable: {e}") from e
-    with f:
-        prefix = f.read(len(_MAGIC))
-        if prefix != _MAGIC:
-            try:
-                return pickle.loads(prefix + f.read())  # legacy pickle
-            except Exception as e:
-                raise CheckpointCorruptionError(
-                    f"{path}: not a v2 snapshot and not a legacy pickle"
-                ) from e
+    try:
+        with f:
+            return _read_snapshot_body(path, f)
+    except CheckpointCorruptionError:
+        raise
+    except OSError as e:
+        # Mid-read I/O failures must surface as corruption, not escape —
+        # maybe_load's cross-rank vote only catches the typed error, and
+        # an untyped escape would strand peers in the vote collective.
+        raise CheckpointCorruptionError(f"{path}: read failed: {e}") from e
+
+
+def _read_snapshot_body(path: str, f):
+    prefix = f.read(len(_MAGIC))
+    if prefix != _MAGIC:
         try:
-            hlen, hcrc_stored = struct.unpack("<QI", f.read(12))
-            header_bytes = f.read(hlen)
-            if (
-                len(header_bytes) != hlen
-                or native.crc32c(header_bytes) != hcrc_stored
-            ):
-                raise CheckpointCorruptionError(
-                    f"{path}: header crc32c mismatch — snapshot is corrupt"
-                )
-            header = pickle.loads(header_bytes)
-            plen = header["payload_len"]
-            payload = np.empty(plen, np.uint8)
-            if f.readinto(memoryview(payload)) != plen:
-                raise CheckpointCorruptionError(f"{path}: payload truncated")
-            (crc_stored,) = struct.unpack("<I", f.read(4))
-        except CheckpointCorruptionError:
-            raise
+            return pickle.loads(prefix + f.read())  # legacy pickle
         except Exception as e:
             raise CheckpointCorruptionError(
-                f"{path}: truncated or garbled"
+                f"{path}: not a v2 snapshot and not a legacy pickle"
             ) from e
+    try:
+        hlen, hcrc_stored = struct.unpack("<QI", f.read(12))
+        header_bytes = f.read(hlen)
+        if (
+            len(header_bytes) != hlen
+            or native.crc32c(header_bytes) != hcrc_stored
+        ):
+            raise CheckpointCorruptionError(
+                f"{path}: header crc32c mismatch — snapshot is corrupt"
+            )
+        header = pickle.loads(header_bytes)
+        plen = header["payload_len"]
+        payload = np.empty(plen, np.uint8)
+        if f.readinto(memoryview(payload)) != plen:
+            raise CheckpointCorruptionError(f"{path}: payload truncated")
+        (crc_stored,) = struct.unpack("<I", f.read(4))
+    except CheckpointCorruptionError:
+        raise
+    except Exception as e:
+        raise CheckpointCorruptionError(
+            f"{path}: truncated or garbled"
+        ) from e
     if native.crc32c(payload) != crc_stored:
         raise CheckpointCorruptionError(
             f"{path}: payload crc32c mismatch — snapshot is corrupt"

