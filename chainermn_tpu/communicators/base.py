@@ -184,12 +184,27 @@ class CommunicatorBase:
         return lax.all_gather(x, self.axes, axis=axis, tiled=tiled)
 
     def gather(self, x, root: int = 0, axis: int = 0):
-        """Traced gather: every device computes the gathered value but only
-        ``root``'s copy is meaningful to callers (SPMD has no cheap true
-        gather; the reference's MPI_Gather is point-to-root).
+        """Traced point-to-root gather (reference ``MPI_Gather``): ``root``
+        receives every device's ``x`` stacked along ``axis``; other devices
+        return zeros (the reference returns ``None`` off-root).
+
+        Lowered as one ppermute per source — each non-root device sends
+        O(message), root receives O(world·message); no all_gather, so the
+        wire cost matches MPI_Gather's point-to-root profile instead of a
+        world broadcast.  Latency is world-linear (one hop per source):
+        for gather-then-use-everywhere patterns prefer :meth:`allgather`,
+        which is a single collective.
         """
-        del root
-        return lax.all_gather(x, self.axes, axis=axis)
+        idx = self.axis_index()
+        parts = []
+        for s in range(self.device_size):
+            if s == root:
+                parts.append(
+                    jnp.where(idx == root, x, jnp.zeros_like(x))
+                )
+            else:
+                parts.append(self.ppermute(x, [(s, root)]))
+        return jnp.stack(parts, axis=axis)
 
     def alltoall(self, x, split_axis: int = 0, concat_axis: int = 0):
         """Traced all-to-all (reference ``alltoall``), the primitive under
@@ -207,17 +222,34 @@ class CommunicatorBase:
         )
 
     def scatter(self, x, root: int = 0):
-        """Traced scatter: root's value is broadcast, each device slices its
-        chunk along axis 0 (reference ``scatter``)."""
+        """Traced point-to-root scatter (reference ``MPI_Scatter``): device
+        ``d`` receives chunk ``d`` of ``root``'s ``x`` along axis 0.
+
+        Lowered as one ppermute per destination carrying only that
+        destination's chunk — each receiver's wire cost is O(chunk) and
+        root's egress O(world·chunk), versus the previous broadcast
+        formulation shipping the WHOLE buffer to every device.  Latency is
+        world-linear; for tiny payloads on large worlds a bcast+slice may
+        win — this lowering optimizes bytes, the binding constraint for
+        the dataset/batch payloads scatter exists for.
+        """
         n = self.device_size
         if x.shape[0] % n:
             raise ValueError(
                 f"scatter axis 0 ({x.shape[0]}) must be divisible by the "
                 f"device count ({n}); pad the input first"
             )
-        x = self.bcast(x, root)
         chunk = x.shape[0] // n
-        return lax.dynamic_slice_in_dim(x, self.axis_index() * chunk, chunk, axis=0)
+        idx = self.axis_index()
+        out = None
+        for d in range(n):
+            piece = lax.slice_in_dim(x, d * chunk, (d + 1) * chunk, axis=0)
+            if d == root:
+                got = jnp.where(idx == root, piece, jnp.zeros_like(piece))
+            else:
+                got = self.ppermute(piece, [(root, d)])
+            out = got if out is None else out + got
+        return out
 
     def ppermute(self, x, perm):
         """``lax.ppermute`` semantics over this communicator's (flattened)
@@ -377,9 +409,15 @@ class CommunicatorBase:
         )
 
     @property
+    def world_axes(self):
+        """This communicator's mesh axes in the form collectives take: the
+        tuple for multi-axis worlds, the bare name for single-axis ones."""
+        return self.axes if len(self.axes) > 1 else self.axes[0]
+
+    @property
     def _world_spec(self):
         """PartitionSpec sharding a leading "rank" axis over the world."""
-        return P(self.axes if len(self.axes) > 1 else self.axes[0])
+        return P(self.world_axes)
 
     def _eager_cached(self, key, stacked_tree, make_body):
         """Build-or-reuse a jitted shard_map for an eager collective.

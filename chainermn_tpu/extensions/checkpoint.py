@@ -283,11 +283,13 @@ def _read_snapshot(path: str):
             return _read_snapshot_body(path, f)
     except CheckpointCorruptionError:
         raise
-    except OSError as e:
-        # Mid-read I/O failures must surface as corruption, not escape —
-        # maybe_load's cross-rank vote only catches the typed error, and
+    except Exception as e:
+        # ANY failure parsing a snapshot file — mid-read I/O errors, schema
+        # skew that passes the crc (unknown dtype strings, missing header
+        # keys), unpack failures — must surface as the typed corruption
+        # error: maybe_load's cross-rank vote only catches that type, and
         # an untyped escape would strand peers in the vote collective.
-        raise CheckpointCorruptionError(f"{path}: read failed: {e}") from e
+        raise CheckpointCorruptionError(f"{path}: unreadable: {e}") from e
 
 
 def _read_snapshot_body(path: str, f):

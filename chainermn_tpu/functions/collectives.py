@@ -45,8 +45,9 @@ def bcast(communicator: CommunicatorBase, x, root: int = 0):
 
 
 def gather(communicator: CommunicatorBase, x, root: int = 0, axis: int = 0):
-    """Differentiable gather (SPMD: materialized on every rank; only root's
-    copy is semantically the reference's output)."""
+    """Differentiable point-to-root gather: root receives the stack, other
+    ranks zeros (the reference returns None off-root).  Backward scatters
+    the stacked cotangent back to each source."""
     return communicator.gather(x, root=root, axis=axis)
 
 

@@ -212,8 +212,7 @@ class MultiNodeChainList:
     # ------------------------------------------------------------------
     @property
     def _world(self):
-        axes = self.comm.axes
-        return axes if len(axes) > 1 else axes[0]
+        return self.comm.world_axes
 
     def shard_params(self, params_list: Sequence[Any]):
         """Pack each component's parameters into its owner's flat fp32 row
@@ -345,18 +344,9 @@ class MultiNodeChainList:
             raise RuntimeError("call shard_params(params_list) first")
 
     def _row_state_spec(self, optimizer, row_size):
-        """PartitionSpecs for an optax state over the local row: row-sized
-        1-D leaves ride the world axis, scalars replicate (the
-        optimizers._zero_inner_spec pattern for the chain's row)."""
-        world = self._world
-        shard = jax.ShapeDtypeStruct((row_size,), jnp.float32)
-        shape = jax.eval_shape(optimizer.init, shard)
-        return jax.tree.map(
-            lambda l: P(world)
-            if (len(l.shape) == 1 and l.shape[0] == row_size)
-            else P(),
-            shape,
-        )
+        from chainermn_tpu.optimizers import flat_shard_state_spec
+
+        return flat_shard_state_spec(optimizer, row_size, self._world)
 
     def make_sharded_train_step(
         self,

@@ -58,6 +58,21 @@ def _check_batch_divisibility(batch, n_dev, n_accum=1):
             )
 
 
+def flat_shard_state_spec(optimizer, shard_size: int, world):
+    """Per-leaf PartitionSpecs for an optax state over a flat fp32 shard:
+    shard-sized 1-D leaves ride the world axes, scalars (e.g. adam's count)
+    replicate.  Shared by the ZeRO optimizer paths and the sharded
+    MultiNodeChainList tier."""
+
+    def leaf_spec(leaf):
+        shape = getattr(leaf, "shape", ())
+        return P(world) if (len(shape) == 1 and shape[0] == shard_size) else P()
+
+    shard = jax.ShapeDtypeStruct((shard_size,), jnp.float32)
+    state_shape = jax.eval_shape(optimizer.init, shard)
+    return jax.tree.map(leaf_spec, state_shape)
+
+
 class MultiNodeOptimizerState(NamedTuple):
     inner: Any            # the wrapped optax optimizer's state
     step: jnp.ndarray     # int32 step counter
@@ -164,18 +179,9 @@ class MultiNodeOptimizer:
         return flat, unpack
 
     def _zero_inner_spec(self, shard_size):
-        """Per-leaf PartitionSpecs for the sharded inner state: flat-shard
-        leaves ride the world axes, scalars (e.g. adam's count) replicate."""
-        comm = self.communicator
-        world = comm.axes if len(comm.axes) > 1 else comm.axes[0]
-
-        def leaf_spec(leaf):
-            shape = getattr(leaf, "shape", ())
-            return P(world) if (len(shape) == 1 and shape[0] == shard_size) else P()
-
-        shard = jax.ShapeDtypeStruct((shard_size,), jnp.float32)
-        state_shape = jax.eval_shape(self.actual_optimizer.init, shard)
-        return jax.tree.map(leaf_spec, state_shape)
+        return flat_shard_state_spec(
+            self.actual_optimizer, shard_size, self.communicator.world_axes
+        )
 
     def _zero_init(self, params):
         comm = self.communicator

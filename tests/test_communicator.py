@@ -143,6 +143,79 @@ def test_scatter(mesh):
         np.testing.assert_allclose(out[r].ravel(), [2 * r, 2 * r + 1])
 
 
+def test_gather_point_to_root(mesh):
+    """gather is point-to-root (reference MPI_Gather): root receives the
+    stack, everyone else zeros — and the lowering moves O(message) per
+    source, not a world all_gather."""
+    comm = create_communicator("naive", mesh=mesh)
+    n = comm.device_size
+    root = n - 1
+
+    def body(xs):
+        return comm.gather(xs[0], root=root)[None]
+
+    f = comm.shard_map(
+        body, in_specs=(comm._world_spec,), out_specs=comm._world_spec
+    )
+    out = np.asarray(jax.jit(f)(jnp.arange(1.0, n + 1.0)))
+    np.testing.assert_allclose(out[root], np.arange(1.0, n + 1.0))
+    for r in range(n):
+        if r != root:
+            np.testing.assert_allclose(out[r], np.zeros(n))
+    assert "all_gather" not in str(
+        jax.make_jaxpr(f)(jnp.arange(1.0, n + 1.0))
+    )
+
+
+def test_gather_grad_scatters_back(mesh):
+    """Differentiating through point-to-root gather: each source receives
+    exactly its slot's cotangent (the transpose of the per-source
+    ppermutes)."""
+    comm = create_communicator("naive", mesh=mesh)
+    n = comm.device_size
+    root = 0
+    weights = jnp.arange(1.0, n + 1.0)
+
+    from jax import lax
+
+    def loss(data):
+        def body(xs):
+            g = comm.gather(xs[0], root=root)
+            # Only root's copy is meaningful; weight its entries.
+            contrib = jnp.where(
+                comm.axis_index() == root, jnp.sum(g * weights), 0.0
+            )
+            return lax.psum(contrib, comm.axes)[None]
+
+        y = comm.shard_map(
+            body, in_specs=(comm._world_spec,), out_specs=comm._world_spec
+        )(data)
+        return y[0]
+
+    g = np.asarray(jax.jit(jax.grad(loss))(jnp.zeros(n)))
+    # Source r's value lands in slot r at root, so its cotangent is
+    # weights[r].
+    np.testing.assert_allclose(g, np.asarray(weights))
+
+
+def test_scatter_avoids_world_broadcast(mesh):
+    """The scatter lowering ships each destination only its own chunk — no
+    bcast/psum of the whole buffer."""
+    comm = create_communicator("naive", mesh=mesh)
+    n = comm.device_size
+    data = jnp.arange(float(n * 2))
+
+    def body(xs):
+        return comm.scatter(xs, root=0)[None]
+
+    jx = str(jax.make_jaxpr(
+        comm.shard_map(body, in_specs=(P(),), out_specs=comm._world_spec)
+    )(data))
+    assert "all_gather" not in jx
+    # The old lowering broadcast the whole buffer via masked psum.
+    assert "psum" not in jx
+
+
 def test_scatter_rejects_indivisible(mesh):
     comm = create_communicator("naive", mesh=mesh)
 
