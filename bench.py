@@ -15,11 +15,16 @@ number is measured against (>1.0 = beating the reference's chips).
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 ``--pipeline`` measures the same step fed by the REAL host input
-pipeline — ``datasets.toy.batch_iterator`` (native parallel_gather batch
-assembly) staged through ``create_prefetch_iterator`` (background
-device_put thread) — instead of a resident synthetic batch, so the number
-includes host batch assembly and host→device transfer overlapped with
-compute.  Same single-JSON-line contract, different metric name.
+pipeline — ``datasets.MultiprocessBatchLoader`` (worker processes
+assembling batches into shared-memory slots) staged through
+``create_prefetch_iterator`` (background device_put thread) — instead of
+a resident synthetic batch, so the number includes host batch assembly
+and host→device transfer overlapped with compute.  Same single-JSON-line
+contract, different metric name.  Caveat for THIS environment: the axon
+tunnel's bulk DMA degrades ~75× once the step executable has run (see
+docs/performance.md "Host input pipeline"), so the end-to-end number is
+transfer-bound at ~20 MB/s here; the pipeline's own stage rates are
+measured in isolation and recorded alongside.
 """
 
 import argparse
@@ -46,12 +51,35 @@ from chainermn_tpu.models.resnet import ResNet50
 REFERENCE_IMAGES_PER_SEC_PER_CHIP = 125.0  # P100, ChainerMN pure_nccl era
 
 
+class SyntheticItems:
+    """Picklable item source for the pipeline bench: 8 distinct base images
+    keep host RAM small while every batch still pays the full per-batch
+    assembly + transfer cost.  Module-level so the spawn-based loader
+    workers can unpickle it."""
+
+    def __init__(self, base, n, n_classes=1000):
+        self.base = base
+        self.n = n
+        self.n_classes = n_classes
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return self.base[i % len(self.base)], np.int32(i % self.n_classes)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--pipeline", action="store_true",
         help="feed the step through the real host input pipeline "
-             "(batch_iterator + prefetch) instead of a resident batch",
+             "(multiprocess shared-memory loader + prefetch) instead of a "
+             "resident batch",
+    )
+    ap.add_argument(
+        "--loader-workers", type=int, default=2,
+        help="worker processes for --pipeline batch assembly",
     )
     args = ap.parse_args(argv)
     comm = chainermn_tpu.create_communicator("xla_ici")
@@ -90,31 +118,36 @@ def main(argv=None):
     y = jnp.asarray(rng.randint(0, 1000, size=global_batch), jnp.int32)
 
     batch_source = None
+    loader = None
     if args.pipeline:
-        # Real host pipeline: items assembled into batches by the native
-        # parallel_gather (datasets.toy.batch_iterator), staged to the
-        # device by the prefetch thread.  8 distinct base images keep host
-        # RAM small while every batch still pays the full 154 MB/global
-        # batch assembly + transfer cost.
-        from chainermn_tpu.datasets.toy import batch_iterator
+        # Real host pipeline: worker PROCESSES assemble each batch into
+        # shared-memory slots (datasets.MultiprocessBatchLoader — the
+        # reference ImageNet example's MultiprocessIterator role), and the
+        # prefetch thread stages slots to the device.  copy=True: the
+        # prefetch thread's device_put is async (and on the CPU backend it
+        # zero-copy ALIASES the source buffer), so handing it recyclable
+        # slot views would corrupt in-flight batches; the fresh-array copy
+        # is the honest cost of a real pipeline, as Chainer's
+        # MultiprocessIterator also returned fresh arrays.
+        from chainermn_tpu.datasets.multiprocess_iterator import (
+            MultiprocessBatchLoader,
+        )
         from chainermn_tpu.iterators import create_prefetch_iterator
 
         base = rng.randn(8, *image).astype(np.float32)
-
-        class _Items:
-            def __len__(self):
-                return global_batch * 4
-
-            def __getitem__(self, i):
-                return base[i % 8], np.int32(i % 1000)
-
-        def batches():
-            while True:
-                yield from batch_iterator(
-                    _Items(), global_batch, shuffle=False
-                )
-
-        batch_source = create_prefetch_iterator(batches(), size=2)
+        loader = MultiprocessBatchLoader(
+            SyntheticItems(base, global_batch * 4),
+            global_batch,
+            n_workers=args.loader_workers,
+            shuffle=False,
+            repeat=True,
+        )
+        # close_join_timeout=None: teardown must WAIT for the producer
+        # thread (the loader's next() is bounded), because loader.close()
+        # unmaps the shared-memory slots the producer may still be copying.
+        batch_source = create_prefetch_iterator(
+            iter(loader), size=2, close_join_timeout=None
+        )
 
     # Model FLOPs for MFU — PER-DEVICE convention throughout: XLA's cost
     # model on the compiled step reports the post-SPMD-partitioned
@@ -172,6 +205,12 @@ def main(argv=None):
     # demonstrated sustained rate.
     peak = 197e12
     mfu = step_flops_per_dev / step_time / peak
+    if loader is not None:
+        # Stop the prefetch producer thread FIRST (its generator close
+        # joins the thread — unbounded, see close_join_timeout above), so
+        # loader.close() never races an active iteration.
+        batch_source.close()
+        loader.close()
     metric = "images/sec/chip ResNet-50 ImageNet train step"
     if args.pipeline:
         metric += " (host pipeline)"

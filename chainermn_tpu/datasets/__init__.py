@@ -5,3 +5,6 @@ from chainermn_tpu.datasets.scatter_dataset import (  # noqa: F401
     SubDataset,
     get_n_iterations_for_one_epoch,
 )
+from chainermn_tpu.datasets.multiprocess_iterator import (  # noqa: F401
+    MultiprocessBatchLoader,
+)

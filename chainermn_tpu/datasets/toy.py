@@ -61,6 +61,24 @@ class SyntheticSeqDataset:
         return self.src[i], self.tgt[i]
 
 
+class ExplodingDataset:
+    """Raises at one index — lets tests assert that loader worker failures
+    propagate to the training loop instead of hanging it.  Module-level so
+    spawn-based loader workers can unpickle it."""
+
+    def __init__(self, inner, explode_at: int):
+        self.inner = inner
+        self.explode_at = explode_at
+
+    def __len__(self):
+        return len(self.inner)
+
+    def __getitem__(self, i):
+        if i == self.explode_at:
+            raise ValueError(f"synthetic item failure at {i}")
+        return self.inner[i]
+
+
 def batch_iterator(dataset, batch_size, *, shuffle=True, seed=0, drop_last=True):
     """Minimal epoch iterator over an indexable dataset, yielding stacked
     numpy batches — the examples' stand-in for Chainer's iterators.

@@ -60,6 +60,7 @@ def create_prefetch_iterator(
     actual_iterator: Iterable,
     size: int = 2,
     sharding=None,
+    close_join_timeout: float | None = 1.0,
 ) -> Iterator:
     """Device-prefetching wrapper: overlap host-side batch production and
     host→device transfer with device compute.
@@ -76,6 +77,14 @@ def create_prefetch_iterator(
     ``sharding`` (optional): a ``jax.sharding.Sharding`` — or a pytree of
     them matching the batch structure — to place batches directly in their
     jitted-step layout and skip the re-layout on dispatch.
+
+    ``close_join_timeout``: bound on waiting for the producer thread at
+    shutdown.  The default (1 s) guards against a producer blocked inside
+    the user's iterator; pass ``None`` for an unbounded join when the
+    source's ``next()`` is known to return in bounded time AND the caller
+    will tear down resources the producer may still be reading (e.g. a
+    shared-memory loader's slots) — an expired bounded join would let
+    that teardown race the producer's final read.
 
     Exceptions in the producer thread re-raise at the consuming ``next()``.
     """
@@ -143,10 +152,10 @@ def create_prefetch_iterator(
             # Join before draining: a producer already inside its ≤0.1 s
             # q.put attempt could otherwise land one last batch AFTER the
             # drain, pinning its device buffers for the process lifetime.
-            # The join is bounded (every put attempt re-checks `stop`);
-            # the timeout only guards a producer blocked inside the user's
-            # iterator itself.
-            t.join(timeout=1.0)
+            # The join is bounded by default (every put attempt re-checks
+            # `stop`); the timeout only guards a producer blocked inside
+            # the user's iterator itself — see ``close_join_timeout``.
+            t.join(timeout=close_join_timeout)
             try:
                 while True:
                     q.get_nowait()
