@@ -188,23 +188,43 @@ class CommunicatorBase:
         receives every device's ``x`` stacked along ``axis``; other devices
         return zeros (the reference returns ``None`` off-root).
 
-        Lowered as one ppermute per source — each non-root device sends
-        O(message), root receives O(world·message); no all_gather, so the
-        wire cost matches MPI_Gather's point-to-root profile instead of a
-        world broadcast.  Latency is world-linear (one hop per source):
-        for gather-then-use-everywhere patterns prefer :meth:`allgather`,
-        which is a single collective.
+        Binomial-tree lowering, ``ceil(log2 n)`` collective rounds: in
+        round ``k`` every device at relative rank ``2^k (mod 2^{k+1})``
+        ships its accumulated block of ``2^k`` messages one tree level
+        rootward, all in ONE ppermute.  Latency is log-depth (the previous
+        one-ppermute-per-source schedule was world-linear — n−1 rounds and
+        O(world²) HLO growth); aggregate wire stays O(world·message) (each
+        message crosses each tree level once): leaves send one round-k
+        block of 2^k rows (exactly O(message) for power-of-two worlds,
+        where every block row is live; on non-power-of-two worlds trailing
+        senders' blocks carry padding rows), internal nodes forward their
+        subtree.
+        For gather-then-use-everywhere patterns prefer :meth:`allgather`,
+        which is a single collective.  For an output that exists ONLY on
+        the root device (no O(world·message) zeros elsewhere), use
+        :meth:`eager_gather`.
         """
+        n = self.device_size
+        if n == 1:
+            return jnp.expand_dims(x, axis)
         idx = self.axis_index()
-        parts = []
-        for s in range(self.device_size):
-            if s == root:
-                parts.append(
-                    jnp.where(idx == root, x, jnp.zeros_like(x))
-                )
-            else:
-                parts.append(self.ppermute(x, [(s, root)]))
-        return jnp.stack(parts, axis=axis)
+        buf = x[None]  # block of messages for relative ranks [me, me+width)
+        for k in range((n - 1).bit_length()):
+            width = 1 << k
+            pairs = [
+                ((s + root) % n, (s - width + root) % n)
+                for s in range(width, n, 2 * width)
+            ]
+            # Senders' current buf holds rel ranks [s, s+width); after the
+            # concat, receivers hold [r, r+2*width).  Non-participants
+            # accumulate junk rows that the final root mask discards.
+            buf = jnp.concatenate([buf, self.ppermute(buf, pairs)], axis=0)
+        buf = buf[:n]  # non-power-of-two worlds: trailing rows are padding
+        # buf rows are in RELATIVE order (row j = flat rank (root+j) % n);
+        # roll restores flat-rank order, then mask to root.
+        buf = jnp.roll(buf, root, axis=0)
+        buf = jnp.where(idx == root, buf, jnp.zeros_like(buf))
+        return jnp.moveaxis(buf, 0, axis) if axis else buf
 
     def alltoall(self, x, split_axis: int = 0, concat_axis: int = 0):
         """Traced all-to-all (reference ``alltoall``), the primitive under
@@ -225,13 +245,15 @@ class CommunicatorBase:
         """Traced point-to-root scatter (reference ``MPI_Scatter``): device
         ``d`` receives chunk ``d`` of ``root``'s ``x`` along axis 0.
 
-        Lowered as one ppermute per destination carrying only that
-        destination's chunk — each receiver's wire cost is O(chunk) and
-        root's egress O(world·chunk), versus the previous broadcast
-        formulation shipping the WHOLE buffer to every device.  Latency is
-        world-linear; for tiny payloads on large worlds a bcast+slice may
-        win — this lowering optimizes bytes, the binding constraint for
-        the dataset/batch payloads scatter exists for.
+        Binomial-tree lowering, ``ceil(log2 n)`` collective rounds (the
+        mirror of :meth:`gather`): the root's buffer halves each round,
+        with the upper half of every current holder's range shipped one
+        tree level leafward in ONE ppermute.  Each receiver's ingress is
+        its power-of-two-padded subtree (= exactly its subtree on
+        power-of-two worlds) and the aggregate wire is O(world·chunk)
+        (each chunk crosses each tree level once) — no broadcast of the
+        whole buffer, and log-depth latency versus the previous
+        one-ppermute-per-destination schedule's world-linear rounds.
         """
         n = self.device_size
         if x.shape[0] % n:
@@ -240,16 +262,31 @@ class CommunicatorBase:
                 f"device count ({n}); pad the input first"
             )
         chunk = x.shape[0] // n
+        if n == 1:
+            return x
         idx = self.axis_index()
-        out = None
-        for d in range(n):
-            piece = lax.slice_in_dim(x, d * chunk, (d + 1) * chunk, axis=0)
-            if d == root:
-                got = jnp.where(idx == root, piece, jnp.zeros_like(piece))
-            else:
-                got = self.ppermute(piece, [(root, d)])
-            out = got if out is None else out + got
-        return out
+        rel = (idx - root) % n
+        K = (n - 1).bit_length()
+        # Message-major layout in RELATIVE rank order (row j = the chunk
+        # for flat rank (root+j) % n), padded to the next power of two so
+        # every round's send block has a static shape.
+        buf = jnp.roll(x.reshape(n, chunk, *x.shape[1:]), -root, axis=0)
+        if (1 << K) != n:
+            pad = jnp.zeros(((1 << K) - n,) + buf.shape[1:], buf.dtype)
+            buf = jnp.concatenate([buf, pad], axis=0)
+        for t in range(K):
+            width = 1 << (K - t - 1)
+            pairs = [
+                ((r + root) % n, (r + width + root) % n)
+                for r in range(0, n, 2 * width)
+                if r + width < n
+            ]
+            got = self.ppermute(buf[width : 2 * width], pairs)
+            # Receivers this round (rel ≡ width mod 2·width) adopt the
+            # shipped block; holders keep their lower half; devices not yet
+            # reached carry junk that a later round overwrites.
+            buf = jnp.where(rel % (2 * width) == width, got, buf[:width])
+        return buf.reshape((chunk,) + x.shape[1:])
 
     def ppermute(self, x, perm):
         """``lax.ppermute`` semantics over this communicator's (flattened)
@@ -454,6 +491,42 @@ class CommunicatorBase:
             return body
 
         return self._eager_cached("allreduce_grad", stacked_tree, make_body)
+
+    def device_for_rank(self, r: int):
+        """The device at flattened rank ``r`` (row-major over ``self.axes``,
+        matching :meth:`axis_index`)."""
+        sizes = [self.mesh.shape[a] for a in self.axes]
+        coords = dict.fromkeys(self.mesh.axis_names, 0)
+        for a, s in zip(reversed(self.axes), reversed(sizes)):
+            coords[a] = r % s
+            r //= s
+        pos = tuple(coords[a] for a in self.mesh.axis_names)
+        return np.asarray(self.mesh.devices)[pos]
+
+    def eager_gather(self, stacked_x, root: int = 0):
+        """Gather a rank-stacked array to the ROOT DEVICE ONLY — the
+        off-root-cheap output form of :meth:`gather`.
+
+        ``stacked_x``: global array with leading ``device_size`` axis (each
+        device's message at its rank slot).  Returns the same array resident
+        solely on ``root``'s device (``SingleDeviceSharding``) — off-root
+        devices hold nothing, versus the traced :meth:`gather`'s uniform
+        SPMD output shape (zeros off-root, unavoidable inside shard_map).
+        This is the TPU-native spelling of MPI_Gather's "only root gets the
+        buffer": a resharding, which XLA lowers to its own point-to-root
+        tree over ICI.  Single-host form (the root device must be
+        addressable from this process; cross-process object gathers go
+        through :meth:`gather_obj`)."""
+        dev = self.device_for_rank(root)
+        if dev.process_index != self.rank:
+            raise ValueError(
+                f"eager_gather root {root} lives on process "
+                f"{dev.process_index}; only its owner can address it — use "
+                "gather_obj for cross-process host-plane gathers"
+            )
+        return jax.device_put(
+            stacked_x, jax.sharding.SingleDeviceSharding(dev)
+        )
 
     def eager_broadcast_data(self, stacked_tree, root: int = 0):
         def make_body():

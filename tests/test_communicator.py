@@ -459,3 +459,92 @@ def test_single_host_rejects_multihost_mesh(devices8):
 def test_unknown_name_raises():
     with pytest.raises(ValueError):
         create_communicator("definitely_not_a_backend")
+
+
+# ---------------------------------------------------------------------------
+# Log-depth point-to-root schedules (binomial tree)
+# ---------------------------------------------------------------------------
+
+
+def test_gather_scatter_log_depth(devices8):
+    """The binomial-tree lowerings run in ceil(log2 n) collective rounds:
+    at n=8 on a single-axis world, exactly 3 ppermutes each (the previous
+    schedule emitted n-1 = 7) and still no all_gather/psum."""
+    from chainermn_tpu.communicators import build_mesh
+
+    mesh = build_mesh(inter_size=1, intra_size=8, devices=devices8)
+    comm = create_communicator("naive", mesh=mesh)
+    n = comm.device_size
+
+    def gather_body(xs):
+        return comm.gather(xs[0], root=2)[None]
+
+    jx = str(jax.make_jaxpr(
+        comm.shard_map(
+            gather_body, in_specs=(comm._world_spec,),
+            out_specs=comm._world_spec,
+        )
+    )(jnp.arange(float(n))))
+    assert jx.count("ppermute") == 3
+    assert "all_gather" not in jx and "psum" not in jx
+
+    def scatter_body(xs):
+        return comm.scatter(xs, root=2)[None]
+
+    jx = str(jax.make_jaxpr(
+        comm.shard_map(
+            scatter_body, in_specs=(P(),), out_specs=comm._world_spec
+        )
+    )(jnp.arange(float(n * 2))))
+    assert jx.count("ppermute") == 3
+    assert "all_gather" not in jx and "psum" not in jx
+
+
+def test_gather_nonzero_root_semantics(mesh):
+    """Binomial schedule with a non-zero root: flat-rank stacking order is
+    preserved (relative-order blocks are rolled back to flat order)."""
+    comm = create_communicator("naive", mesh=mesh)
+    n = comm.device_size
+    for root in (1, n - 1):
+        def body(xs):
+            return comm.gather(xs[0] * 10.0, root=root)[None]
+
+        f = jax.jit(comm.shard_map(
+            body, in_specs=(comm._world_spec,), out_specs=comm._world_spec
+        ))
+        out = np.asarray(f(jnp.arange(float(n))))
+        np.testing.assert_allclose(out[root], 10.0 * np.arange(n))
+
+
+def test_eager_gather_root_device_only(mesh):
+    """eager_gather returns the stacked result resident ONLY on the root
+    device — the off-root-cheap output form."""
+    comm = create_communicator("naive", mesh=mesh)
+    n = comm.device_size
+    x = jax.device_put(
+        jnp.arange(float(n * 3)).reshape(n, 3),
+        jax.sharding.NamedSharding(comm.mesh, comm._world_spec),
+    )
+    for root in (0, n - 1):
+        out = comm.eager_gather(x, root=root)
+        assert isinstance(out.sharding, jax.sharding.SingleDeviceSharding)
+        assert next(iter(out.sharding.device_set)) == comm.device_for_rank(root)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_device_for_rank_matches_axis_index(mesh):
+    """device_for_rank must invert the traced axis_index flattening."""
+    comm = create_communicator("naive", mesh=mesh)
+    n = comm.device_size
+
+    def body(_):
+        return comm.axis_index()[None]
+
+    ranks = jax.jit(comm.shard_map(
+        body, in_specs=(comm._world_spec,), out_specs=comm._world_spec
+    ))(jnp.zeros(n))
+    # The traced axis_index value r must live on device_for_rank(r):
+    # flat_rank's row-major flattening and the host-side inverse agree.
+    for shard in ranks.addressable_shards:
+        r = int(np.asarray(shard.data).item())
+        assert shard.device == comm.device_for_rank(r), (r, shard.device)
