@@ -146,6 +146,48 @@ def main():
         assert comm.recv_obj(source=0, tag=7) == big
         comm.send_obj("ack", dest=0)
 
+    # Typed ndarray fast path (reference MpiCommunicatorBase moves ndarrays
+    # as first-class typed buffers): multi-chunk float32, a 0-d scalar, a
+    # non-contiguous view (contiguified on send), and an empty array must
+    # all round-trip with exact dtype/shape/values — and arrive as
+    # ndarrays, not pickles of them.
+    typed = np.random.RandomState(11).randn(
+        3 * ((2 * kvtransport.CHUNK_BYTES) // 24) + 3
+    ).astype(np.float64)
+    if pid == 0:
+        comm.send_obj(typed, dest=1, tag=9)
+        comm.send_obj(np.array(2.5, np.float32), dest=1, tag=9)
+        comm.send_obj(typed.reshape(-1, 3)[:, 1], dest=1, tag=9)  # strided
+        comm.send_obj(np.empty((0, 4), np.int16), dest=1, tag=9)
+    elif pid == 1:
+        got = comm.recv_obj(source=0, tag=9)
+        assert isinstance(got, np.ndarray) and got.dtype == np.float64
+        np.testing.assert_array_equal(got, typed)
+        got = comm.recv_obj(source=0, tag=9)
+        assert isinstance(got, np.ndarray)
+        assert got.shape == () and got.dtype == np.float32
+        assert float(got) == 2.5
+        got = comm.recv_obj(source=0, tag=9)
+        np.testing.assert_array_equal(got, typed.reshape(-1, 3)[:, 1])
+        got = comm.recv_obj(source=0, tag=9)
+        assert got.shape == (0, 4) and got.dtype == np.int16
+
+    # Same matrix over the KV chunk fallback plane (the path used where
+    # direct TCP is unavailable): flip the plane on BOTH processes in SPMD
+    # order, round-trip typed + pickled payloads, flip back.
+    kvtransport.ObjectPlane._use_sockets = False
+    try:
+        if pid == 0:
+            comm.send_obj(typed, dest=1, tag=13)
+            comm.send_obj({"via": "kv"}, dest=1, tag=13)
+        elif pid == 1:
+            got = comm.recv_obj(source=0, tag=13)
+            assert isinstance(got, np.ndarray)
+            np.testing.assert_array_equal(got, typed)
+            assert comm.recv_obj(source=0, tag=13) == {"via": "kv"}
+    finally:
+        kvtransport.ObjectPlane._use_sockets = True
+
     # scatter_obj is point-to-point under the KV plane: each rank receives
     # exactly its own element from root.
     items = [f"item{r}" for r in range(nproc)] if pid == 0 else None
