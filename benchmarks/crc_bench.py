@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""CRC32C throughput — the checkpoint-integrity checksum's cost.
+
+The checksum runs over every checkpoint payload at save AND load
+(extensions/checkpoint.py), so its rate bounds how much integrity
+checking costs relative to disk/transport.  Prints one JSON line per
+measured implementation: the active native path (hardware SSE4.2 or
+software slicing-by-8 — see ``hostbuf_crc32c_impl``) and the pure-Python
+tail (small buffer, scaled).
+
+Usage: python benchmarks/crc_bench.py [--size-mb 256]
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from chainermn_tpu.utils import native
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size-mb", type=int, default=256)
+    args = ap.parse_args()
+    data = np.random.RandomState(0).bytes(args.size_mb << 20)
+
+    native.crc32c(data)  # warm (build/load the library)
+    iters = 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        native.crc32c(data)
+    dt = (time.perf_counter() - t0) / iters
+    print(
+        json.dumps(
+            {
+                "metric": "crc32c",
+                "impl": native.crc32c_impl(),
+                "value": round(args.size_mb / 1024 / dt, 2),
+                "unit": "GB/s",
+                "size_mb": args.size_mb,
+            }
+        )
+    )
+
+    # Pure-Python tail, small buffer (it runs ~MB/s).
+    small = data[: 1 << 20]
+    t0 = time.perf_counter()
+    py = native._crc32c_py(small, 0)
+    dt = time.perf_counter() - t0
+    assert py == native.crc32c(small)
+    print(
+        json.dumps(
+            {
+                "metric": "crc32c",
+                "impl": "python",
+                "value": round(1 / 1024 / dt, 4),
+                "unit": "GB/s",
+                "size_mb": 1,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

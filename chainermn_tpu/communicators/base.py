@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 import pickle
 from typing import Any, Callable, Sequence
 
@@ -102,10 +103,24 @@ class CommunicatorBase:
         # (every process builds the same communicators in the same order —
         # the same contract MPI_Comm_create relies on), so a class-level
         # creation counter yields matching key namespaces on all processes,
-        # playing the role of an MPI communicator context id.
+        # playing the role of an MPI communicator context id.  The contract
+        # is VERIFIED, not trusted: each plane publishes its construction
+        # site (the first user frame below) at creation and checks it
+        # against rank 0's at first use, so a rank-conditional
+        # create_communicator fails fast with a diagnostic instead of
+        # silently delivering another stream's payloads or hanging.
+        import traceback
+
+        site = "<unknown>"
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for frame in reversed(traceback.extract_stack()[:-1]):
+            if not frame.filename.startswith(pkg):
+                site = f"{frame.filename}:{frame.lineno}"
+                break
         CommunicatorBase._plane_count += 1
         self._obj_plane = kvtransport.ObjectPlane(
-            f"comm{CommunicatorBase._plane_count}", self.rank, self.size
+            f"comm{CommunicatorBase._plane_count}", self.rank, self.size,
+            site=site,
         )
 
     # ------------------------------------------------------------------

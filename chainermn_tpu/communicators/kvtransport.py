@@ -574,11 +574,63 @@ class ObjectPlane:
     collective does — and the job should abort (the except hook's role).
     """
 
-    def __init__(self, namespace: str, rank: int, size: int):
+    def __init__(
+        self, namespace: str, rank: int, size: int, site: str = "<unknown>"
+    ):
         self.namespace = namespace
         self.rank = rank
         self.size = size
+        self.site = site
         self._seq: dict[Any, int] = {}
+        self._validated = size == 1
+        # Publish this plane's construction-site fingerprint NOW (one
+        # non-blocking put): first use on any rank validates against rank
+        # 0's, turning a breached SPMD-construction-order contract into a
+        # fast diagnostic instead of a silent stream mixup or hang.
+        # Publication at construction (not first use) matters because rank
+        # 0 may never use a plane's host ops at all.
+        if not self._validated and available():
+            try:
+                client().key_value_set(
+                    f"{_PREFIX}/planecheck/{namespace}/{rank}", site
+                )
+            except Exception:
+                pass  # duplicate keys on re-init: validation degrades soft
+
+    def _ensure_validated(self) -> None:
+        """First-use check of the SPMD construction-order contract (see
+        base.py's plane-count comment): this plane's construction site
+        must match rank 0's for the same namespace ordinal."""
+        if self._validated:
+            return
+        self._validated = True
+        timeout_ms = int(
+            _os.environ.get("CHAINERMN_TPU_PLANE_CHECK_TIMEOUT_MS", "60000")
+        )
+        key = f"{_PREFIX}/planecheck/{self.namespace}/0"
+        try:
+            root_site = _blocking_get(
+                client().blocking_key_value_get, key,
+                time.monotonic() + timeout_ms / 1e3,
+            )
+        except Exception:
+            raise RuntimeError(
+                f"host-plane {self.namespace} (constructed at {self.site} "
+                f"on rank {self.rank}): rank 0 never constructed a plane "
+                f"with this ordinal within {timeout_ms} ms — communicator "
+                "construction order diverged across processes "
+                "(rank-conditional create_communicator?)"
+            ) from None
+        if root_site != self.site and "<unknown>" not in (
+            root_site, self.site
+        ):
+            raise RuntimeError(
+                f"host-plane {self.namespace} construction-site mismatch: "
+                f"rank {self.rank} built it at {self.site}, rank 0 at "
+                f"{root_site} — the SPMD construction-order contract is "
+                "breached; payloads would be delivered to the wrong "
+                "streams"
+            )
 
     def _peek(self, slot) -> int:
         return self._seq.get(slot, 0)
@@ -598,6 +650,7 @@ class ObjectPlane:
     _use_sockets = _os.environ.get("CHAINERMN_TPU_SOCKET_P2P", "1") != "0"
 
     def send(self, obj, dest: int, tag: int = 0) -> None:
+        self._ensure_validated()
         slot = ("p2p", self.rank, dest, tag)
         if self._use_sockets:
             socket_plane(self.rank).send(
@@ -613,6 +666,7 @@ class ObjectPlane:
     def recv(
         self, source: int, tag: int = 0, *, timeout_ms: int | None = None
     ):
+        self._ensure_validated()
         slot = ("p2p", source, self.rank, tag)
         if self._use_sockets:
             obj = socket_plane(self.rank).recv(
@@ -630,6 +684,7 @@ class ObjectPlane:
 
     # -- collectives ---------------------------------------------------
     def bcast(self, obj, root: int):
+        self._ensure_validated()
         slot = ("bcast", root)
         key = self._key("bcast", root, self._peek(slot))
         if self.rank == root:
@@ -642,6 +697,7 @@ class ObjectPlane:
         return obj
 
     def allgather(self, obj) -> list:
+        self._ensure_validated()
         slot = ("gather",)
         base = self._key("gather", self._peek(slot))
         put_payload(f"{base}/{self.rank}", obj)
@@ -666,6 +722,7 @@ class ObjectPlane:
         any tag can never interleave with internal collective matching
         (the role of MPI's per-context internal tags); KV keys are the
         socket-less fallback."""
+        self._ensure_validated()
         slot = ("scatter", root)
         seq = self._peek(slot)
         ns = f"{self.namespace}#scatter{root}"

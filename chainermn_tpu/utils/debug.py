@@ -9,6 +9,16 @@ as a hang or garbage).  This debug mode makes the contract checkable:
 * ``CollectiveTrace`` wraps a communicator; every traced collective call
   records (op, shape, dtype, axes) into an order log at *trace time* —
   exactly when the SPMD program's collective sequence is fixed.
+* Host/object-plane ops (``send_obj``/``recv_obj``/``bcast_obj``/
+  ``gather_obj``/``allreduce_obj``/``scatter_obj``/``barrier``) are
+  recorded too — (op, plane namespace, endpoint ints, payload type) —
+  because the SPMD contract the object plane trusts (same ops, same
+  order, on every process) is exactly what this mode exists to check.
+  Construction-order divergence is additionally caught without debug
+  mode: every plane publishes its construction site and validates it
+  against rank 0's at first use (kvtransport.ObjectPlane), and a
+  barrier-sequence skew fails fast inside ``sync_global_devices``'s
+  name-equality assertion.
 * ``fingerprint()`` hashes the log (native crc32c);
   ``verify_across_hosts()`` allgathers the fingerprint over the object
   plane and raises on divergence, pinpointing the first differing entry.
@@ -28,6 +38,13 @@ _WRAPPED = (
     "broadcast_data",
 )
 
+# Host/object-plane ops: recorded by endpoint metadata and payload TYPE
+# (not content — payloads may be huge and rank-varying by design).
+_WRAPPED_OBJ = (
+    "send_obj", "recv_obj", "bcast_obj", "gather_obj", "allgather_obj",
+    "allreduce_obj", "scatter_obj", "barrier",
+)
+
 
 class CollectiveTrace:
     """Wrap ``comm`` so every collective appends to an order log.
@@ -39,6 +56,11 @@ class CollectiveTrace:
     def __init__(self, comm: CommunicatorBase):
         self._comm = comm
         self.log: List[str] = []
+        # The cross-host equality check covers only SYMMETRIC ops: p2p
+        # send_obj/recv_obj are rank-asymmetric by design (the sender logs
+        # a send, the receiver a recv), so they appear in `log` for the
+        # diagnostic trail but not in the verified sequence.
+        self._sym: List[str] = []
 
     def _record(self, op: str, x: Any, **meta):
         import jax
@@ -49,9 +71,26 @@ class CollectiveTrace:
              "dtype": str(getattr(l, "dtype", type(l).__name__))}
             for l in leaves
         ]
-        self.log.append(json.dumps(
-            {"op": op, "args": desc, **meta}, sort_keys=True
-        ))
+        entry = json.dumps({"op": op, "args": desc, **meta}, sort_keys=True)
+        self.log.append(entry)
+        self._sym.append(entry)
+
+    def _record_obj(self, op: str, args, kwargs):
+        meta = {
+            "plane": self._comm._obj_plane.namespace,
+            "args": [
+                a if isinstance(a, (int, str)) else type(a).__name__
+                for a in args
+            ],
+            "kwargs": {
+                k: v if isinstance(v, (int, str)) else type(v).__name__
+                for k, v in kwargs.items()
+            },
+        }
+        entry = json.dumps({"op": op, **meta}, sort_keys=True)
+        self.log.append(entry)
+        if op not in ("send_obj", "recv_obj"):
+            self._sym.append(entry)
 
     def __getattr__(self, name):
         attr = getattr(self._comm, name)
@@ -61,19 +100,26 @@ class CollectiveTrace:
                 return attr(x, *args, **kwargs)
 
             return traced
+        if name in _WRAPPED_OBJ and callable(attr):
+            def traced_obj(*args, **kwargs):
+                self._record_obj(name, args, kwargs)
+                return attr(*args, **kwargs)
+
+            return traced_obj
         return attr
 
     # -- verification ---------------------------------------------------
     def fingerprint(self) -> int:
-        return native.crc32c("\n".join(self.log).encode())
+        return native.crc32c("\n".join(self._sym).encode())
 
     def verify_across_hosts(self) -> int:
-        """Raise RuntimeError if any host recorded a different collective
-        order; returns the common fingerprint otherwise."""
+        """Raise RuntimeError if any host recorded a different (symmetric)
+        collective/object-plane order; returns the common fingerprint
+        otherwise."""
         fp = self.fingerprint()
         fps = self._comm.gather_obj(fp)
         if len(set(fps)) > 1:
-            logs = self._comm.gather_obj(self.log)
+            logs = self._comm.gather_obj(self._sym)
             first_diff = None
             for i in range(max(len(l) for l in logs)):
                 entries = {
@@ -91,3 +137,4 @@ class CollectiveTrace:
 
     def reset(self):
         self.log.clear()
+        self._sym.clear()

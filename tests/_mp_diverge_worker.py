@@ -1,0 +1,79 @@
+"""Worker for the SPMD construction-order divergence test.
+
+Run as: python _mp_diverge_worker.py <pid> <nproc> <port> <mode>
+
+Deliberately breaches the SPMD communicator-construction contract and
+expects the host plane to FAIL FAST with a diagnostic (the round-2 design
+trusted the contract: a breach silently desynchronized every later
+send/recv/bcast key namespace, delivering wrong payloads or hanging).
+
+mode "site":    both ranks build one communicator, but at different source
+                lines → construction-site mismatch raised at first use.
+mode "ordinal": rank 1 builds an EXTRA communicator first, so its shared
+                communicator has plane ordinal 2 while rank 0's has 1 →
+                rank 1's first use times out waiting for rank 0's
+                never-published plane-2 fingerprint and raises.
+
+Prints "DIVERGE_OK <pid>" when the expected diagnostic fired.
+"""
+
+import os
+import sys
+
+
+def main():
+    pid, nproc, port, mode = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    )
+    os.environ["CHAINERMN_TPU_PLANE_CHECK_TIMEOUT_MS"] = "3000"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    from chainermn_tpu.communicators import create_communicator
+
+    if mode == "site":
+        if pid == 0:
+            comm = create_communicator("naive")
+        else:
+            comm = create_communicator("naive")  # different line: site diverges
+        try:
+            comm.bcast_obj({"x": 1}, root=0)
+        except RuntimeError as e:
+            assert "construction-site mismatch" in str(e), e
+            print(f"DIVERGE_OK {pid}", flush=True)
+            return
+        # Rank 0 compares against itself and cannot see the breach; any
+        # OTHER rank must have raised.
+        assert pid == 0, "non-root rank missed the site divergence"
+        print(f"DIVERGE_OK {pid}", flush=True)
+        return
+
+    if mode == "ordinal":
+        if pid == 1:
+            _extra = create_communicator("naive")  # rank-conditional!
+        comm = create_communicator("naive")
+        try:
+            # Root-side bcast returns without waiting on peers, so rank 0
+            # exits cleanly while rank 1's first use must raise: its
+            # shared communicator has plane ordinal 2, which rank 0 never
+            # constructed.
+            comm.bcast_obj({"x": 1}, root=0)
+        except RuntimeError as e:
+            assert "construction order diverged" in str(e), e
+            print(f"DIVERGE_OK {pid}", flush=True)
+            return
+        assert pid == 0, "rank 1 missed the ordinal divergence"
+        print(f"DIVERGE_OK {pid}", flush=True)
+        return
+
+    raise SystemExit(f"unknown mode {mode}")
+
+
+if __name__ == "__main__":
+    main()

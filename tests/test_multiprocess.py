@@ -52,3 +52,39 @@ def test_two_process_object_plane(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
         assert f"MP_WORKER_OK {i}" in out, f"worker {i} output:\n{out}"
+
+
+_DIVERGE_WORKER = os.path.join(
+    os.path.dirname(__file__), "_mp_diverge_worker.py"
+)
+
+
+@pytest.mark.parametrize("mode", ["site", "ordinal"])
+def test_construction_order_divergence_fails_fast(mode):
+    """A rank-conditional create_communicator (breaching the SPMD
+    construction contract the host plane's key namespaces rely on) must
+    fail FAST with a diagnostic, not hang or deliver mixed-up payloads."""
+    port = _free_port()
+    env = subprocess_env(n_devices=1)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _DIVERGE_WORKER, str(i), "2", str(port), mode],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(
+            "divergence was not detected (workers hung):\n" + "\n".join(outs)
+        )
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"DIVERGE_OK {i}" in out, f"worker {i} output:\n{out}"

@@ -217,3 +217,28 @@ def test_facade_exposes_every_lazy_attribute():
 
     with _pytest.raises(AttributeError):
         c.definitely_not_an_attribute
+
+
+def test_collective_trace_records_object_plane(mesh):
+    """Host/object-plane ops enter the order log; asymmetric p2p ops are
+    logged for the diagnostic trail but excluded from the verified
+    (cross-host-compared) sequence."""
+    from chainermn_tpu.communicators import create_communicator
+
+    comm = create_communicator("naive", mesh=mesh)
+    dbg = debug.CollectiveTrace(comm)
+    dbg.bcast_obj({"k": 1}, root=0)   # single host: returns obj, still logged
+    dbg.gather_obj("x")
+    dbg.allreduce_obj(2)
+    dbg.barrier()
+    assert len(dbg.log) >= 4
+    assert "bcast_obj" in dbg.log[0] and "plane" in dbg.log[0]
+    sym_before = len(dbg._sym)
+    # p2p is rank-asymmetric by design: recorded, not verified.
+    try:
+        dbg.send_obj("p", dest=1)
+    except Exception:
+        pass  # single-process: send_obj itself rejects; recording happened first
+    assert any("send_obj" in e for e in dbg.log)
+    assert len(dbg._sym) == sym_before
+    dbg.verify_across_hosts()  # single host: trivially consistent
