@@ -85,6 +85,22 @@ POLL_SLICE_MS = 60_000
 
 _PREFIX = "chainermn_tpu"
 
+# Upper bound on a single socket-plane frame payload.  A corrupt header
+# must not drive a multi-GB allocation on the receiver, so the reader
+# enforces it — and the SENDER enforces the same bound so an oversized
+# payload fails loudly on the sending rank instead of poisoning the
+# receiver's plane.  Env-tunable (set IDENTICALLY on every process) for
+# giant object sends.  Headers are small JSON; their length prefix gets
+# its own tight cap.
+MAX_FRAME_BYTES = int(
+    _os.environ.get("CHAINERMN_TPU_MAX_FRAME_BYTES", str(16 << 30))
+)
+MAX_HEADER_BYTES = 1 << 20
+
+# Sentinel pushed into every route queue when a reader thread dies on a
+# malformed frame, so blocked recvs raise instead of hanging to timeout.
+_POISON = object()
+
 _pool: ThreadPoolExecutor | None = None
 
 
@@ -189,11 +205,13 @@ def _byte_view(a: np.ndarray) -> memoryview:
 
 
 def _is_typed_array(obj) -> bool:
-    """Payloads eligible for the raw-buffer path: ndarrays whose dtype
-    holds no Python references anywhere (``hasobject`` also catches
+    """Payloads eligible for the raw-buffer path: plain ndarrays whose
+    dtype holds no Python references anywhere (``hasobject`` also catches
     structured dtypes with object fields, which ``dtype != object``
-    would not)."""
-    return isinstance(obj, np.ndarray) and not obj.dtype.hasobject
+    would not).  Exactly ``np.ndarray`` — subclasses (``np.matrix``,
+    ``np.ma.MaskedArray``) carry state a raw buffer would drop, so they
+    take the pickle path, which round-trips them faithfully."""
+    return type(obj) is np.ndarray and not obj.dtype.hasobject
 
 
 def put_payload(key: str, obj) -> None:
@@ -363,6 +381,7 @@ class SocketPlane:
         self._socket = _socket
         self._queues: dict[tuple, Any] = {}
         self._queues_lock = threading.Lock()
+        self._broken: str | None = None  # first reader decode failure
         self._send_socks: dict[int, Any] = {}
         self._send_lock = threading.Lock()
         self._token = secrets.token_bytes(TOKEN_BYTES)
@@ -457,15 +476,32 @@ class SocketPlane:
                 if not self._read_exact(conn, memoryview(lenbuf)):
                     return
                 (hlen,) = struct.unpack("<I", lenbuf)
+                if hlen > MAX_HEADER_BYTES:
+                    raise ValueError(
+                        f"frame header length {hlen} exceeds "
+                        f"{MAX_HEADER_BYTES} (stream desync/corruption?)"
+                    )
                 hbuf = bytearray(hlen)
                 if not self._read_exact(conn, memoryview(hbuf)):
                     return
                 hdr = _json.loads(hbuf.decode())
-                nbytes = hdr["nbytes"]
-                if hdr["kind"] == "nd":
-                    a = np.empty(
-                        tuple(hdr["shape"]), np.dtype(hdr["dtype"])
+                nbytes = int(hdr["nbytes"])
+                if nbytes < 0 or nbytes > MAX_FRAME_BYTES:
+                    raise ValueError(
+                        f"frame nbytes {nbytes} outside [0, "
+                        f"{MAX_FRAME_BYTES}]"
                     )
+                if hdr["kind"] == "nd":
+                    dt = np.dtype(hdr["dtype"])
+                    shape = tuple(int(s) for s in hdr["shape"])
+                    want = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+                    if want != nbytes:
+                        raise ValueError(
+                            f"frame header inconsistent: dtype {dt} shape "
+                            f"{shape} implies {want} bytes, header says "
+                            f"{nbytes}"
+                        )
+                    a = np.empty(shape, dt)
                     if not self._read_exact(conn, _byte_view(a)):
                         return
                     obj = a
@@ -478,6 +514,20 @@ class SocketPlane:
                 self._queue(route).put((hdr["seq"], obj))
         except OSError:
             return  # peer died; except-hook territory
+        except Exception as e:
+            # A malformed frame must not kill the reader silently: record
+            # the failure so every pending/future recv raises a transport
+            # error instead of hanging to its timeout (ADVICE r3 #3).
+            self._broken = f"{type(e).__name__}: {e}"
+            with self._queues_lock:
+                queues = list(self._queues.values())
+            for q in queues:
+                q.put(_POISON)
+            try:
+                conn.close()
+            except Exception:
+                pass
+            return
 
     def recv(
         self, ns: str, source: int, tag: int, seq: int,
@@ -486,14 +536,26 @@ class SocketPlane:
         import queue as _q
 
         q = self._queue((ns, source, tag))
+        if self._broken is not None:
+            raise RuntimeError(
+                f"host-plane socket reader on rank {self.rank} died "
+                f"decoding a frame: {self._broken}"
+            )
         timeout = None if timeout_ms is None else timeout_ms / 1e3
         try:
-            got_seq, obj = q.get(timeout=timeout)
+            item = q.get(timeout=timeout)
         except _q.Empty:
             raise TimeoutError(
                 f"recv_obj from {source} tag {tag}: nothing arrived in "
                 f"{timeout_ms} ms"
             ) from None
+        if item is _POISON:
+            q.put(_POISON)  # keep other waiters on this route failing fast
+            raise RuntimeError(
+                f"host-plane socket reader on rank {self.rank} died "
+                f"decoding a frame: {self._broken}"
+            )
+        got_seq, obj = item
         if got_seq != seq:
             raise RuntimeError(
                 f"host-plane stream desync on edge {source}->{self.rank} "
@@ -537,6 +599,13 @@ class SocketPlane:
         else:
             payload = memoryview(pickle.dumps(obj))
             hdr = {"kind": "pkl", "nbytes": len(payload)}
+        if hdr["nbytes"] > MAX_FRAME_BYTES:
+            raise ValueError(
+                f"socket-plane payload of {hdr['nbytes']} bytes exceeds "
+                f"MAX_FRAME_BYTES ({MAX_FRAME_BYTES}); raise "
+                "CHAINERMN_TPU_MAX_FRAME_BYTES identically on every "
+                "process to send objects this large"
+            )
         hdr.update(ns=ns, src=self.rank, tag=tag, seq=seq)
         hbytes = _json.dumps(hdr).encode()
         with self._send_lock:
@@ -621,15 +690,32 @@ class ObjectPlane:
                 "construction order diverged across processes "
                 "(rank-conditional create_communicator?)"
             ) from None
-        if root_site != self.site and "<unknown>" not in (
-            root_site, self.site
+        # The TRUE contract is ordinal matching — rank 0 constructed a
+        # plane with this namespace ordinal at all (checked fatally
+        # above).  Site equality is only a heuristic fingerprint:
+        # heterogeneous checkout paths or a legal rank-conditional
+        # wrapper calling create_communicator satisfy the ordinal
+        # contract with different filename:lineno, so a mismatch warns
+        # rather than aborts (ADVICE r3 #2).  Basenames are compared to
+        # tolerate differing install prefixes across hosts.
+        def _basename_site(s: str) -> str:
+            path, _, line = s.rpartition(":")
+            return f"{_os.path.basename(path)}:{line}" if path else s
+
+        if (
+            _basename_site(root_site) != _basename_site(self.site)
+            and "<unknown>" not in (root_site, self.site)
         ):
-            raise RuntimeError(
+            import warnings
+
+            warnings.warn(
                 f"host-plane {self.namespace} construction-site mismatch: "
                 f"rank {self.rank} built it at {self.site}, rank 0 at "
-                f"{root_site} — the SPMD construction-order contract is "
-                "breached; payloads would be delivered to the wrong "
-                "streams"
+                f"{root_site}.  If communicator construction ORDER also "
+                "diverged across processes, payloads will be delivered "
+                "to the wrong streams.",
+                RuntimeWarning,
+                stacklevel=3,
             )
 
     def _peek(self, slot) -> int:

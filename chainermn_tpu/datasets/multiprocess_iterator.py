@@ -147,6 +147,8 @@ class MultiprocessBatchLoader:
             raise ValueError(f"batch_size must be >= 1, got {batch_size}")
         self._dataset = dataset
         self._n = len(dataset)
+        if self._n == 0:
+            raise ValueError("dataset is empty")
         if self._n < batch_size and drop_last:
             raise ValueError(
                 f"dataset ({self._n}) smaller than one batch ({batch_size})"
@@ -227,6 +229,12 @@ class MultiprocessBatchLoader:
             yield from self._epoch_batches(e)
 
     def __len__(self):
+        if self._repeat:
+            raise TypeError(
+                "MultiprocessBatchLoader with repeat=True is an infinite "
+                "iterator and has no length; use len(loader) only with "
+                "repeat=False (per-epoch batch count)"
+            )
         per = (
             self._n // self._batch_size
             if self._drop_last

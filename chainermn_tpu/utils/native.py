@@ -56,35 +56,47 @@ def get_lib() -> Optional[ctypes.CDLL]:
         except OSError:
             _load_failed = True
             return None
-        lib.hostbuf_crc32c.restype = ctypes.c_uint32
-        lib.hostbuf_crc32c.argtypes = [
-            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32,
+        try:
+            return _bind_symbols(lib)
+        except AttributeError:
+            # A stale/prebuilt .so missing expected symbols (e.g. the
+            # source was removed so no rebuild triggered) degrades to the
+            # Python fallback chain instead of raising out of get_lib.
+            _load_failed = True
+            return None
+
+
+def _bind_symbols(lib: ctypes.CDLL) -> ctypes.CDLL:
+    global _lib
+    lib.hostbuf_crc32c.restype = ctypes.c_uint32
+    lib.hostbuf_crc32c.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32,
+    ]
+    lib.hostbuf_crc32c_impl.restype = ctypes.c_int
+    for name in ("hostbuf_gatherv", "hostbuf_scatterv"):
+        fn = getattr(lib, name)
+        fn.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint64, ctypes.c_int,
         ]
-        lib.hostbuf_crc32c_impl.restype = ctypes.c_int
-        for name in ("hostbuf_gatherv", "hostbuf_scatterv"):
-            fn = getattr(lib, name)
-            fn.argtypes = [
-                ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
-                ctypes.POINTER(ctypes.c_uint64),
-                ctypes.POINTER(ctypes.c_uint64),
-                ctypes.c_uint64, ctypes.c_int,
-            ]
-        lib.hostbuf_queue_new.restype = ctypes.c_void_p
-        lib.hostbuf_queue_new.argtypes = [ctypes.c_uint64]
-        lib.hostbuf_queue_push.restype = ctypes.c_int
-        lib.hostbuf_queue_push.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
-        ]
-        lib.hostbuf_queue_pop.restype = ctypes.c_uint64
-        lib.hostbuf_queue_pop.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
-        ]
-        lib.hostbuf_queue_size.restype = ctypes.c_uint64
-        lib.hostbuf_queue_size.argtypes = [ctypes.c_void_p]
-        lib.hostbuf_queue_close.argtypes = [ctypes.c_void_p]
-        lib.hostbuf_queue_free.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return _lib
+    lib.hostbuf_queue_new.restype = ctypes.c_void_p
+    lib.hostbuf_queue_new.argtypes = [ctypes.c_uint64]
+    lib.hostbuf_queue_push.restype = ctypes.c_int
+    lib.hostbuf_queue_push.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+    ]
+    lib.hostbuf_queue_pop.restype = ctypes.c_uint64
+    lib.hostbuf_queue_pop.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+    ]
+    lib.hostbuf_queue_size.restype = ctypes.c_uint64
+    lib.hostbuf_queue_size.argtypes = [ctypes.c_void_p]
+    lib.hostbuf_queue_close.argtypes = [ctypes.c_void_p]
+    lib.hostbuf_queue_free.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
 
 
 _CRC32C_TABLES: Optional[list] = None

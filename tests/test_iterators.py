@@ -271,3 +271,19 @@ def test_mp_loader_clean_shutdown(mp_loader_ds):
     assert ld._shms == []
     with pytest.raises(RuntimeError, match="closed"):
         iter(ld)
+
+
+def test_mp_loader_len_and_empty_guards(mp_loader_ds):
+    """repeat=True has no length (infinite); empty datasets are rejected
+    eagerly with a clear error rather than a bare IndexError from _probe."""
+    from chainermn_tpu.datasets.multiprocess_iterator import (
+        MultiprocessBatchLoader,
+    )
+
+    with MultiprocessBatchLoader(
+        mp_loader_ds, 16, n_workers=1, repeat=True
+    ) as ld:
+        with pytest.raises(TypeError, match="infinite"):
+            len(ld)
+    with pytest.raises(ValueError, match="empty"):
+        MultiprocessBatchLoader([], 4, drop_last=False)

@@ -242,3 +242,19 @@ def test_collective_trace_records_object_plane(mesh):
     assert any("send_obj" in e for e in dbg.log)
     assert len(dbg._sym) == sym_before
     dbg.verify_across_hosts()  # single host: trivially consistent
+
+
+def test_typed_array_path_excludes_ndarray_subclasses():
+    """The raw-buffer wire path must only take PLAIN ndarrays: subclasses
+    (np.matrix, MaskedArray) carry state a raw buffer drops, so they must
+    round-trip via pickle (ADVICE r3 #1)."""
+    import numpy as np
+
+    from chainermn_tpu.communicators.kvtransport import _is_typed_array
+
+    assert _is_typed_array(np.zeros((2, 2)))
+    assert _is_typed_array(np.zeros((), np.float32))  # 0-d plain
+    assert not _is_typed_array(np.matrix([[1.0]]))
+    assert not _is_typed_array(np.ma.masked_array([1, 2], mask=[0, 1]))
+    assert not _is_typed_array(np.array([object()]))  # object dtype
+    assert not _is_typed_array([1, 2, 3])
