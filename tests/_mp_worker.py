@@ -13,13 +13,17 @@ def main():
 
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ["JAX_PLATFORMS"] = "cpu"
-    # Force exactly 2 virtual devices per process, replacing any inherited
+    # Force the per-process virtual device count (default 4 → the
+    # (inter=2, intra=4) deployment shape of SURVEY §2.6: a mesh whose
+    # inter leg crosses a REAL process boundary while each process owns
+    # several local devices), replacing any inherited
     # host_platform_device_count (pytest's conftest sets 8).
+    ndev = int(os.environ.get("CHAINERMN_TPU_TEST_LOCAL_DEVICES", "4"))
     flags = [
         f for f in os.environ.get("XLA_FLAGS", "").split()
         if "host_platform_device_count" not in f
     ]
-    flags.append("--xla_force_host_platform_device_count=2")
+    flags.append(f"--xla_force_host_platform_device_count={ndev}")
     os.environ["XLA_FLAGS"] = " ".join(flags)
 
     import jax
@@ -30,7 +34,7 @@ def main():
         process_id=pid,
     )
     assert jax.process_index() == pid
-    assert jax.device_count() == 2 * nproc
+    assert jax.device_count() == ndev * nproc
 
     import numpy as np
 
@@ -41,8 +45,8 @@ def main():
     comm = create_communicator("naive")
     # Host-plane topology: one process per "node" (inter row).
     assert comm.rank == pid and comm.size == nproc
-    assert comm.device_size == 2 * nproc
-    assert comm.inter_size == nproc and comm.intra_size == 2
+    assert comm.device_size == ndev * nproc
+    assert comm.inter_size == nproc and comm.intra_size == ndev
 
     # Object plane across REAL process boundaries (the reference's pickled
     # MPI transport, here over the jax.distributed DCN analogue).
@@ -97,6 +101,58 @@ def main():
     w_everywhere = comm.gather_obj(np.asarray(params["w"]).tolist())
     for w in w_everywhere[1:]:
         np.testing.assert_allclose(w, w_everywhere[0], rtol=1e-6)
+
+    # Traced binomial-tree gather/scatter whose point-to-root tree spans
+    # the REAL process boundary (root on process 1; sources on process 0
+    # must relay through the inter leg).  shard_map runs SPMD over the
+    # global mesh, so each process verifies its own addressable shards.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_dev = comm.device_size
+    root_rank = n_dev - 1  # last device: owned by the LAST process
+    wsharding = NamedSharding(comm.mesh, comm._world_spec)
+    src = np.arange(float(n_dev), dtype=np.float32)
+    xs_in = jax.make_array_from_callback(
+        (n_dev,), wsharding, lambda idx: src[idx]
+    )
+
+    def gather_body(xs):
+        return comm.gather(xs[0] * 10.0, root=root_rank)[None]
+
+    gout = jax.jit(comm.shard_map(
+        gather_body, in_specs=(comm._world_spec,),
+        out_specs=comm._world_spec,
+    ))(xs_in)
+    for shard in gout.addressable_shards:
+        r = shard.index[0].start or 0
+        if r == root_rank:
+            np.testing.assert_allclose(
+                np.asarray(shard.data).reshape(-1),
+                10.0 * np.arange(n_dev),
+            )
+    # The root row is addressable exactly on the last process.
+    has_root = any(
+        (s.index[0].start or 0) == root_rank
+        for s in gout.addressable_shards
+    )
+    assert has_root == (pid == nproc - 1), (pid, has_root)
+
+    full = np.arange(float(2 * n_dev), dtype=np.float32)
+    rep = jax.make_array_from_callback(
+        (2 * n_dev,), NamedSharding(comm.mesh, P()), lambda idx: full[idx]
+    )
+
+    def scatter_body(xs):
+        return comm.scatter(xs, root=root_rank)[None]
+
+    sout = jax.jit(comm.shard_map(
+        scatter_body, in_specs=(P(),), out_specs=comm._world_spec,
+    ))(rep)
+    for shard in sout.addressable_shards:
+        r = shard.index[0].start or 0
+        np.testing.assert_allclose(
+            np.asarray(shard.data).reshape(-1), full[2 * r : 2 * r + 2],
+        )
 
     # Multi-host checkpointer: leaves spanning non-addressable devices are
     # saved as per-process shard lists and re-assembled against the

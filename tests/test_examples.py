@@ -31,6 +31,7 @@ def _run(script, *flags, timeout=420):
     return proc.stdout
 
 
+@pytest.mark.slow
 def test_mnist_naive():
     out = _run(
         "mnist/train_mnist.py", "--communicator", "naive",
@@ -40,6 +41,7 @@ def test_mnist_naive():
     assert "epoch" in out.lower()
 
 
+@pytest.mark.slow
 def test_imagenet_smoke():
     _run(
         "imagenet/train_imagenet.py", "--communicator", "xla_ici",
@@ -49,6 +51,7 @@ def test_imagenet_smoke():
     )
 
 
+@pytest.mark.slow
 def test_seq2seq_smoke():
     _run(
         "seq2seq/seq2seq.py", "--communicator", "naive",
@@ -57,6 +60,7 @@ def test_seq2seq_smoke():
     )
 
 
+@pytest.mark.slow
 def test_parallel_convolution_smoke():
     _run(
         "parallel_convolution/train_parallel_conv.py",

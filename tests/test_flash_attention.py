@@ -89,6 +89,7 @@ def test_flash_backward_matches_oracle(causal):
         )
 
 
+@pytest.mark.slow
 def test_flash_trains_through_transformer():
     """End-to-end: a tiny causal LM with flash attention must train (the
     gap that motivated the backward kernels — ulysses/flash paths crashed

@@ -180,6 +180,7 @@ def mp_loader_ds():
     return SyntheticImageDataset(n=64, shape=(8, 8))
 
 
+@pytest.mark.slow
 def test_mp_loader_matches_batch_iterator(mp_loader_ds):
     """Same (shuffle, seed, drop_last) → byte-identical batches in the same
     order as the single-process oracle, across repeated passes and after an
@@ -209,6 +210,7 @@ def test_mp_loader_matches_batch_iterator(mp_loader_ds):
         np.testing.assert_array_equal(got2[-1][0], ref[-1][0])
 
 
+@pytest.mark.slow
 def test_mp_loader_repeat_reshuffles_and_zero_copy(mp_loader_ds):
     """repeat=True crosses epoch boundaries reshuffling with seed+epoch;
     copy=False batches are exact while within the validity window."""
@@ -236,6 +238,7 @@ def test_mp_loader_repeat_reshuffles_and_zero_copy(mp_loader_ds):
             )
 
 
+@pytest.mark.slow
 def test_mp_loader_worker_exception_propagates(mp_loader_ds):
     from chainermn_tpu.datasets.multiprocess_iterator import (
         MultiprocessBatchLoader,
@@ -250,6 +253,7 @@ def test_mp_loader_worker_exception_propagates(mp_loader_ds):
             list(ld)
 
 
+@pytest.mark.slow
 def test_mp_loader_clean_shutdown(mp_loader_ds):
     """close() must terminate every worker process and release the shared
     memory (no leaked processes; slots unlinked)."""

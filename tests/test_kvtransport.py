@@ -164,3 +164,52 @@ def test_socket_plane_rejects_unauthenticated_connection(sock_pair):
     p0.send("c", 1, 0, 0, "legit")
     assert p1.recv("c", 0, 0, 0, timeout_ms=20000) == "legit"
     evil.close()
+
+
+def test_malformed_frame_poisons_recv_not_hangs(sock_pair):
+    """A malformed frame (bogus header) must not kill the reader thread
+    silently: pending and future recvs raise a transport RuntimeError
+    promptly instead of hanging to their timeout (ADVICE r3 #3)."""
+    import socket as _socket
+    import struct
+    import time as _time
+
+    p0, p1 = sock_pair
+    # Park a payload on one route first so its queue exists, then a
+    # blocked reader on another route.
+    p0.send("c", 1, 1, 0, "parked")
+    assert p1.recv("c", 0, 1, 0, timeout_ms=20000) == "parked"
+
+    # Hand-craft a corrupt frame on a fresh authenticated connection:
+    # nbytes wildly inconsistent with dtype/shape.
+    ep = p1._srv.getsockname()
+    conn = _socket.create_connection(ep)
+    conn.sendall(p1._token)
+    hdr = (
+        b'{"kind": "nd", "dtype": "<f4", "shape": [4], '
+        b'"nbytes": 999999999999, "ns": "c", "src": 0, "tag": 2, "seq": 0}'
+    )
+    conn.sendall(struct.pack("<I", len(hdr)) + hdr)
+
+    deadline = _time.monotonic() + 10
+    while p1._broken is None and _time.monotonic() < deadline:
+        _time.sleep(0.02)
+    assert p1._broken is not None and "nbytes" in p1._broken
+    # Existing-route recv fails fast (poisoned), not by timeout.
+    t0 = _time.monotonic()
+    with pytest.raises(RuntimeError, match="died decoding"):
+        p1.recv("c", 0, 1, 1, timeout_ms=60_000)
+    assert _time.monotonic() - t0 < 5
+    # New-route recv also fails fast via the _broken check.
+    with pytest.raises(RuntimeError, match="died decoding"):
+        p1.recv("c", 0, 99, 0, timeout_ms=60_000)
+    conn.close()
+
+
+def test_oversized_send_raises_on_sender(sock_pair, monkeypatch):
+    """A payload above MAX_FRAME_BYTES fails loudly on the SENDING rank
+    with an actionable error instead of poisoning the receiver."""
+    p0, _p1 = sock_pair
+    monkeypatch.setattr(kv, "MAX_FRAME_BYTES", 1024)
+    with pytest.raises(ValueError, match="CHAINERMN_TPU_MAX_FRAME_BYTES"):
+        p0.send("c", 1, 0, 0, np.zeros(4096, np.float64))

@@ -19,6 +19,7 @@ def test_mlp():
     assert m.apply(p, x).shape == (4, 10)
 
 
+@pytest.mark.slow
 def test_resnet18_with_bn_state():
     m = ResNet18(num_classes=10)
     x = jnp.zeros((2, 32, 32, 3))
@@ -30,6 +31,7 @@ def test_resnet18_with_bn_state():
 
 
 @pytest.mark.parametrize("cls,size", [(AlexNet, 96), (NiN, 64), (GoogLeNet, 64)])
+@pytest.mark.slow
 def test_convnets(cls, size):
     m = cls(num_classes=10)
     x = jnp.zeros((2, size, size, 3))
@@ -88,6 +90,7 @@ def test_dummy_communicator():
     assert group[2].bcast_obj(None, root=0) == "x"
 
 
+@pytest.mark.slow
 def test_kv_cache_generate_matches_full_prefix():
     """KV-cache incremental decoding must reproduce the naive
     full-prefix-per-token greedy decode token for token."""

@@ -49,6 +49,7 @@ def test_top1_route_capacity():
     assert 0 < float(combine[0, 0, 0]) <= 1
 
 
+@pytest.mark.slow
 def test_moe_matches_oracle(ep_mesh):
     experts = make_experts()
     gate_w = jax.random.normal(jax.random.PRNGKey(1), (D, E)) * 0.5

@@ -15,6 +15,8 @@ import pytest
 
 from conftest import subprocess_env
 
+pytestmark = pytest.mark.slow
+
 _WORKER = os.path.join(os.path.dirname(__file__), "_mp_worker.py")
 
 

@@ -15,7 +15,7 @@ import sys
 
 import pytest
 
-pytestmark = pytest.mark.tpu
+pytestmark = [pytest.mark.tpu, pytest.mark.slow]
 
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_on_tpu_worker.py")
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

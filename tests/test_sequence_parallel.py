@@ -82,6 +82,7 @@ def test_ulysses_matches_full(seq_mesh, causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_gradients_match(seq_mesh):
     q, k, v = make_qkv()
 
@@ -126,6 +127,7 @@ def test_ulysses_rejects_bad_head_count(seq_mesh):
         )(q, k, v)
 
 
+@pytest.mark.slow
 def test_sequence_parallel_transformer_lm_matches_dense(seq_mesh):
     """FULL sequence-parallel LM: tokens sharded over the sequence axis,
     ring attention + global position offsets — output must match the dense
@@ -186,6 +188,7 @@ def test_zigzag_indices_roundtrip():
     assert list(shard0[c:]) == list(range(S - c, S))
 
 
+@pytest.mark.slow
 def test_zigzag_ring_attention_matches_full(seq_mesh):
     from chainermn_tpu.parallel.ring_attention import (
         inverse_zigzag_indices,
@@ -218,6 +221,7 @@ def test_zigzag_ring_attention_matches_full(seq_mesh):
     )
 
 
+@pytest.mark.slow
 def test_zigzag_ring_attention_backward(seq_mesh):
     from chainermn_tpu.parallel.ring_attention import (
         zigzag_indices,
@@ -253,6 +257,7 @@ def test_zigzag_ring_attention_backward(seq_mesh):
 
 
 @pytest.mark.parametrize("use_flash", [False, True])
+@pytest.mark.slow
 def test_zigzag_flash_inner_matches_full(seq_mesh, use_flash):
     """The flash-kernel inner loop ("ring outside, flash inside") must
     agree with the dense inner loop and the full-attention oracle, forward

@@ -66,6 +66,7 @@ def test_param_spec_shards_heads_and_ff():
     assert emb and all(s == P() for s in emb)
 
 
+@pytest.mark.slow
 def test_gspmd_step_matches_replicated_oracle(dp_tp_mesh):
     lm, tokens, params = make_lm_and_data()
     loss_fn = lm_loss_fn(lm)
