@@ -82,6 +82,12 @@ def _to_host(tree):
         if isinstance(x, jax.Array):
             if x.is_fully_addressable:
                 return np.asarray(x)
+            if x.sharding.is_fully_replicated:
+                # Replicated over a multi-process mesh: every device holds
+                # the whole value, so save ONE local replica as a plain
+                # array (restorable against any template), not a
+                # redundant per-device shard list.
+                return np.asarray(x.addressable_shards[0].data)
             return _ShardList(
                 [np.asarray(s.data) for s in x.addressable_shards],
                 [s.index for s in x.addressable_shards],
@@ -125,7 +131,18 @@ def _restore_leaf(tpl, saved):
         )
     arr = np.asarray(saved)
     if isinstance(tpl, jax.Array):
-        return jax.device_put(arr.astype(tpl.dtype), tpl.sharding)
+        # Placement fidelity: device_put COMMITS the result to the
+        # template's sharding.  That is wanted for explicitly-placed
+        # templates (and required for non-addressable ones), but a fresh
+        # model.init produces UNCOMMITTED arrays that jit is free to
+        # re-place — restoring those as committed single-device arrays
+        # would poison a later shard_map step with a device mismatch.
+        # Uncommitted fully-addressable templates therefore restore as
+        # host arrays, preserving jit's placement freedom.
+        committed = getattr(tpl, "_committed", True)
+        if committed or not tpl.is_fully_addressable:
+            return jax.device_put(arr.astype(tpl.dtype), tpl.sharding)
+        return arr.astype(tpl.dtype)
     return arr.astype(getattr(tpl, "dtype", arr.dtype))
 
 

@@ -81,6 +81,12 @@ class Evaluator:
         totals: Dict[str, float] = {}
         count = 0
         for batch in batches:
+            if self.comm.size > 1:
+                # Multi-process: each rank yields its LOCAL slice; the
+                # jitted step wants the device-global batch.  (Every rank
+                # must yield the same number of batches — guaranteed by
+                # scatter_dataset's force_equal_length default.)
+                batch = self.comm.global_batch(batch)
             out = self._step(params, batch)
             for k, v in out.items():
                 totals[k] = totals.get(k, 0.0) + float(v)

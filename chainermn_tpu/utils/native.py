@@ -204,6 +204,20 @@ def crc32c(data, seed: int = 0) -> int:
     return int(lib.hostbuf_crc32c(data, len(data), seed))
 
 
+def tree_digest(tree) -> int:
+    """Deterministic crc32c fingerprint of every array leaf of a pytree,
+    folded in ``jax.tree.leaves`` order.  Two runs producing bit-identical
+    parameters produce equal digests — the fault-tolerance examples print
+    it so the kill-and-resume test can assert exact resume."""
+    import jax
+
+    digest = 0
+    for leaf in jax.tree.leaves(tree):
+        a = np.ascontiguousarray(np.asarray(leaf))
+        digest = crc32c(_byte_view(a), seed=digest)
+    return digest
+
+
 def _crc32c_fallback(data, seed: int) -> int:
     if seed == 0:
         accel = _accel_crc32c()

@@ -54,6 +54,15 @@ def main(argv=None):
     p.add_argument("--prefetch", type=int, default=2,
                    help="device-prefetch queue depth (0 disables) — the "
                    "reference's MultiprocessIterator overlap")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="enable fault tolerance: multi-node checkpointer "
+                   "saves here and auto-resumes from the newest consistent "
+                   "generation on relaunch (reference: "
+                   "create_multi_node_checkpointer + maybe_load)")
+    p.add_argument("--checkpoint-every", type=int, default=50,
+                   help="save a generation every N global steps")
+    p.add_argument("--checkpoint-name", default="imagenet",
+                   help="checkpoint set name under --checkpoint-dir")
     args = p.parse_args(argv)
 
     comm = chainermn_tpu.create_communicator(args.communicator)
@@ -144,27 +153,89 @@ def main(argv=None):
 
     evaluator = Evaluator(metric_fn, comm)
 
+    # Fault tolerance (reference: REF:examples' checkpointer usage +
+    # REF:chainermn/extensions/checkpoint.py): a crashed/killed run
+    # relaunched with the same command line resumes from the newest
+    # consistent generation — mid-epoch, at the exact step — and the
+    # global except hook turns any rank's uncaught error into a whole-job
+    # abort instead of a hang.
+    ckpt = None
+    start_epoch = start_step = gstep = 0
+    if args.checkpoint_dir:
+        from chainermn_tpu.extensions import create_multi_node_checkpointer
+        from chainermn_tpu.global_except_hook import add_hook
+
+        add_hook()
+        ckpt = create_multi_node_checkpointer(
+            args.checkpoint_name, comm, path=args.checkpoint_dir
+        )
+        template = {
+            "params": params, "state": state, "batch_stats": batch_stats,
+            "epoch": 0, "step": 0,
+        }
+        loaded, it = ckpt.maybe_load(template)
+        if it is not None:
+            params, state = loaded["params"], loaded["state"]
+            batch_stats = loaded["batch_stats"]
+            start_epoch, start_step = int(loaded["epoch"]), int(loaded["step"])
+            gstep = it
+            if comm.rank == 0:
+                print(
+                    f"resumed from iteration {it} "
+                    f"(epoch {start_epoch}, step {start_step})"
+                )
+
+    # Multi-process deployment (the reference's mpiexec shape): each
+    # process draws a LOCAL slice of the global batch from its scattered
+    # shard and comm.global_batch assembles the device-global arrays.
+    if args.batchsize % comm.size:
+        raise SystemExit(
+            f"--batchsize {args.batchsize} must divide by the process "
+            f"count {comm.size}"
+        )
+    local_bs = args.batchsize // comm.size
+
     def host_batches(epoch):
         # Host-side work (cast/augment) runs here — inside the prefetch
         # thread when enabled, overlapped with device compute.
-        for batch in batch_iterator(train, args.batchsize, seed=epoch):
+        for batch in batch_iterator(train, local_bs, seed=epoch):
             yield (batch[0].astype(np.float32), batch[1])
 
-    for epoch in range(args.epochs):
+    for epoch in range(start_epoch, args.epochs):
         t0, n_seen, last_loss, n_steps = time.perf_counter(), 0, float("nan"), 0
+        # Resuming into this epoch: replay the iterator (same epoch seed →
+        # same permutation) and drop the batches already trained on.
+        skip = start_step if epoch == start_epoch else 0
+        start_step = 0
         batches = host_batches(epoch)
         if args.prefetch > 0:
             batches = chainermn_tpu.create_prefetch_iterator(
                 batches, size=args.prefetch
             )
         for batch in batches:
-            x = batch[0]
+            if skip > 0:
+                skip -= 1
+                n_steps += 1
+                if args.steps and n_steps >= args.steps:
+                    break  # the cap counts replayed steps too
+                continue
+            gb = (batch[0], batch[1])
+            if comm.size > 1:
+                gb = comm.global_batch(gb)
             params, state, batch_stats, loss = step(
-                params, state, batch_stats, (x, batch[1])
+                params, state, batch_stats, gb
             )
-            n_seen += x.shape[0]
+            n_seen += gb[0].shape[0]
             n_steps += 1
+            gstep += 1
             last_loss = loss
+            if ckpt is not None and gstep % args.checkpoint_every == 0:
+                ckpt.save(
+                    {"params": params, "state": state,
+                     "batch_stats": batch_stats,
+                     "epoch": epoch, "step": n_steps},
+                    gstep, block=False,
+                )
             if args.steps and n_steps >= args.steps:
                 break
         sync(last_loss)  # host readback: honest timing on all backends
@@ -172,7 +243,7 @@ def main(argv=None):
 
         metrics = evaluator.evaluate(
             (params, batch_stats),
-            batch_iterator(val, args.batchsize, shuffle=False),
+            batch_iterator(val, local_bs, shuffle=False),
         )
         if comm.rank == 0:
             ips = n_seen / dt
@@ -181,6 +252,14 @@ def main(argv=None):
                 f"epoch {epoch}: loss {float(last_loss):.4f}  "
                 + "  ".join(f"{k} {v:.4f}" for k, v in metrics.items())
                 + f"  {ips:,.1f} img/s ({per_chip:,.1f}/chip)"
+            )
+    if ckpt is not None:
+        ckpt.wait()
+        from chainermn_tpu.utils.native import tree_digest
+
+        if comm.rank == 0:
+            print(
+                f"final gstep {gstep} params_digest {tree_digest(params):08x}"
             )
     return params, batch_stats
 

@@ -70,6 +70,12 @@ def main(argv=None):
                    default="bfloat16")
     p.add_argument("--dp", type=int, default=None,
                    help="data-parallel ways (inter axis); rest is sequence")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="enable fault tolerance: save/auto-resume via the "
+                   "multi-node checkpointer (maybe_load on relaunch)")
+    p.add_argument("--checkpoint-every", type=int, default=10,
+                   help="save a generation every N steps")
+    p.add_argument("--checkpoint-name", default="long_context")
     args = p.parse_args(argv)
 
     comm = chainermn_tpu.create_communicator("xla_ici", inter_size=args.dp)
@@ -214,22 +220,58 @@ def main(argv=None):
     perm = seq_perm if args.sp == "zigzag" else np.arange(S)
     wt = jnp.asarray(wt_np[:, perm])
 
+    # Fault tolerance: relaunching the same command line resumes from the
+    # newest consistent generation.  The data stream is an rng sequence,
+    # so resume replays (draws and discards) the consumed batches — the
+    # restored run sees byte-identical remaining data.
+    ckpt = None
+    resume_step = gstep = 0
+    if args.checkpoint_dir:
+        from chainermn_tpu.extensions import create_multi_node_checkpointer
+        from chainermn_tpu.global_except_hook import add_hook
+
+        add_hook()
+        ckpt = create_multi_node_checkpointer(
+            args.checkpoint_name, comm, path=args.checkpoint_dir
+        )
+        loaded, it = ckpt.maybe_load({"carry": carry})
+        if it is not None:
+            carry = loaded["carry"]
+            resume_step = gstep = it
+            if comm.rank == 0:
+                print(f"resumed from step {it}")
+
     last = float("nan")
     for epoch in range(args.epochs):
         t0, n_tok = time.perf_counter(), 0
-        for _ in range(args.steps_per_epoch):
+        for i in range(args.steps_per_epoch):
             tok_np = successor_batch(rng, B, S, vocab)
+            if epoch * args.steps_per_epoch + i < resume_step:
+                continue  # replayed rng draw; already trained pre-crash
             tgt_np = np.roll(tok_np, -1, axis=1)
             tok = jnp.asarray(tok_np[:, perm])
             tgt = jnp.asarray(tgt_np[:, perm])
             carry, last = step(carry, (tok, tgt, wt))
             n_tok += B * S
-        sync(last)  # host readback: honest timing on all backends
+            gstep += 1
+            if ckpt is not None and gstep % args.checkpoint_every == 0:
+                ckpt.save({"carry": carry}, gstep, block=False)
+        if n_tok:
+            sync(last)  # host readback: honest timing on all backends
         dt = time.perf_counter() - t0
-        if comm.rank == 0:
+        if comm.rank == 0 and n_tok:
             print(
                 f"epoch {epoch}: loss {float(last):.4f} "
                 f"({n_tok / dt:,.0f} tok/s)"
+            )
+    if ckpt is not None:
+        ckpt.wait()
+        from chainermn_tpu.utils.native import tree_digest
+
+        if comm.rank == 0:
+            print(
+                f"final step {gstep} params_digest "
+                f"{tree_digest(carry[0]):08x}"
             )
     return float(last)
 

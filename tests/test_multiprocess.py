@@ -90,3 +90,81 @@ def test_construction_order_divergence_fails_fast(mode):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
         assert f"DIVERGE_OK {i}" in out, f"worker {i} output:\n{out}"
+
+
+_RESUME_WORKER = os.path.join(
+    os.path.dirname(__file__), "_mp_resume_worker.py"
+)
+
+
+def _run_resume_workers(ckpt_dir, crash_after, timeout=420):
+    port = _free_port()
+    env = subprocess_env(n_devices=2)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _RESUME_WORKER, str(i), "2", str(port),
+             str(ckpt_dir), str(crash_after)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("resume workers timed out:\n" + "\n".join(outs))
+    return procs, outs
+
+
+def _digest(outs):
+    import re
+
+    for out in outs:
+        m = re.search(r"params_digest ([0-9a-f]{8})", out)
+        if m:
+            return m.group(1)
+    pytest.fail("no params_digest in worker output:\n" + "\n".join(outs))
+
+
+def test_kill9_and_resume_bit_identical(tmp_path):
+    """End-to-end fault tolerance on the REAL imagenet example under a
+    2-process jax.distributed world: SIGKILL both processes mid-epoch
+    (after a consistent generation exists), relaunch the same command
+    line, and the run must (a) resume from a saved iteration rather than
+    restart, and (b) finish with parameters BIT-IDENTICAL to an
+    uninterrupted oracle run."""
+    import re
+
+    # Oracle: uninterrupted run (8 global steps at this config).
+    oracle_dir = tmp_path / "oracle"
+    procs, outs = _run_resume_workers(oracle_dir, crash_after=0)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"oracle worker {i} failed:\n{out}"
+    oracle = _digest(outs)
+
+    # Crash run: both processes SIGKILL themselves once generation >= 5
+    # is consistent on disk (mid-epoch-1: step 5 of 8).
+    crash_dir = tmp_path / "crash"
+    procs, outs = _run_resume_workers(crash_dir, crash_after=5)
+    # At least one process dies by its own SIGKILL; the peer may either
+    # also SIGKILL itself or crash out when the killed coordinator's
+    # control plane vanishes under it (rc != 0 either way).
+    codes = [p.returncode for p in procs]
+    assert -9 in codes, f"no SIGKILL observed: {codes}\n" + "\n".join(outs)
+    assert all(c != 0 for c in codes), (
+        f"a worker exited cleanly in the crash phase: {codes}"
+    )
+
+    # Relaunch: must resume (not restart) and reproduce the oracle.
+    procs, outs = _run_resume_workers(crash_dir, crash_after=0)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"resume worker {i} failed:\n{out}"
+    m = re.search(r"resumed from iteration (\d+)", "\n".join(outs))
+    assert m, "relaunch did not resume:\n" + "\n".join(outs)
+    assert int(m.group(1)) >= 5
+    assert _digest(outs) == oracle
