@@ -84,7 +84,22 @@ class CommunicatorBase:
         mesh: Mesh | None = None,
         axes: Sequence[str] | None = None,
         allreduce_grad_dtype: Any | None = None,
+        host_members: Sequence[int] | None = None,
     ):
+        # Subgroup membership (``split(color, key)``): the ordered GLOBAL
+        # process indices participating in this communicator's host plane.
+        # None = the full world.  The calling process must be a member.
+        self._hp_members = (
+            list(host_members) if host_members is not None else None
+        )
+        if (
+            self._hp_members is not None
+            and jax.process_index() not in self._hp_members
+        ):
+            raise ValueError(
+                f"process {jax.process_index()} is not in host_members "
+                f"{self._hp_members}"
+            )
         if mesh is None:
             mesh = mesh_utils.build_mesh()
         self.mesh = mesh
@@ -119,8 +134,9 @@ class CommunicatorBase:
                 break
         CommunicatorBase._plane_count += 1
         self._obj_plane = kvtransport.ObjectPlane(
-            f"comm{CommunicatorBase._plane_count}", self.rank, self.size,
-            site=site,
+            f"comm{CommunicatorBase._plane_count}",
+            jax.process_index(), self.size,
+            site=site, members=self._hp_members,
         )
 
     # ------------------------------------------------------------------
@@ -128,10 +144,14 @@ class CommunicatorBase:
     # ------------------------------------------------------------------
     @property
     def rank(self) -> int:
+        if self._hp_members is not None:
+            return self._hp_members.index(jax.process_index())
         return jax.process_index()
 
     @property
     def size(self) -> int:
+        if self._hp_members is not None:
+            return len(self._hp_members)
         return jax.process_count()
 
     @property
@@ -142,7 +162,10 @@ class CommunicatorBase:
 
     @property
     def local_devices(self):
-        return [d for d in self.mesh.devices.flat if d.process_index == self.rank]
+        # Compare against the GLOBAL process index: on a split() subgroup
+        # self.rank is subgroup-relative while d.process_index is global.
+        me = jax.process_index()
+        return [d for d in self.mesh.devices.flat if d.process_index == me]
 
     # ------------------------------------------------------------------
     # Device-plane topology (chip granularity)
@@ -533,7 +556,7 @@ class CommunicatorBase:
         addressable from this process; cross-process object gathers go
         through :meth:`gather_obj`)."""
         dev = self.device_for_rank(root)
-        if dev.process_index != self.rank:
+        if dev.process_index != jax.process_index():
             raise ValueError(
                 f"eager_gather root {root} lives on process "
                 f"{dev.process_index}; only its owner can address it — use "
@@ -642,7 +665,19 @@ class CommunicatorBase:
             # the reference's ``chunked_bcast_obj``
             # (REF:.../_communication_utility.py).
             return self._obj_plane.bcast(obj, root)
+        self._require_subgroup_kv("bcast_obj")
         return self._bcast_obj_devices(obj, root)
+
+    def _require_subgroup_kv(self, op: str) -> None:
+        """The multihost_utils fallbacks below are WORLD collectives: on a
+        split() subgroup they would mix colors' payloads (or deadlock), so
+        subgroups insist on the coordination-service object plane."""
+        if self._hp_members is not None:
+            raise RuntimeError(
+                f"{op} on a split() subgroup requires the jax.distributed "
+                "coordination service (the world-collective fallback "
+                "cannot scope to a subgroup)"
+            )
 
     def _bcast_obj_devices(self, obj, root: int):
         """Fallback broadcast over device collectives for multi-process
@@ -659,16 +694,30 @@ class CommunicatorBase:
         out = multihost_utils.broadcast_one_to_all(buf, is_source=self.rank == root)
         return pickle.loads(np.asarray(out).tobytes())
 
-    def gather_obj(self, obj, root: int = 0):
-        """Gather every process's object; the full list is returned on all
-        ranks (allgather semantics — the reference returns it only at
-        ``root``, but rank-symmetric returns keep SPMD callers branch-free
-        and every in-tree caller wants them).  Payloads travel at their
-        exact size — no pad-to-max."""
+    def gather_obj(self, obj, root: int | None = None):
+        """Gather every process's object.
+
+        ``root=None`` (default): allgather semantics — the full list on
+        every rank, which keeps SPMD callers branch-free (every in-tree
+        symmetric caller wants this).
+
+        ``root=r``: the reference's ``MPI_Gather`` wire profile
+        (REF:chainermn/communicators/mpi_communicator_base.py ``gather``)
+        — every non-root sends ONLY to root (O(n * payload) total wire,
+        non-root processes fetch nothing) and the list is returned at
+        root, ``None`` elsewhere.
+
+        Payloads travel at their exact size — no pad-to-max."""
         if self.size == 1:
             return [obj]
+        if root is not None:
+            if not (0 <= root < self.size):
+                raise ValueError(f"gather_obj root {root} out of range")
+            self._require_kv("gather_obj(root=...)")
+            return self._obj_plane.gather(obj, root)
         if kvtransport.available():
             return self._obj_plane.allgather(obj)
+        self._require_subgroup_kv("gather_obj")
         from jax.experimental import multihost_utils
 
         payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
@@ -706,44 +755,206 @@ class CommunicatorBase:
     _barrier_seq = 0  # class-level: every process advances it identically
 
     def barrier(self):
-        if self.size > 1:
-            from jax.experimental import multihost_utils
+        if self.size <= 1:
+            return
+        if self._hp_members is not None:
+            # Subgroup barrier: must involve ONLY the members (a world
+            # barrier would deadlock against other colors).  An obj-plane
+            # allgather of a token has exactly MPI_Barrier's completion
+            # semantics: no member returns before every member arrived.
+            self.allgather_obj(None)
+            return
+        from jax.experimental import multihost_utils
 
-            # sync_global_devices asserts the name matches across processes;
-            # SPMD processes hit barriers in the same order, so a class-level
-            # sequence number is stable where id(self) would not be.
-            CommunicatorBase._barrier_seq += 1
-            multihost_utils.sync_global_devices(
-                f"chainermn_tpu_barrier_{CommunicatorBase._barrier_seq}"
-            )
+        # sync_global_devices asserts the name matches across processes;
+        # SPMD processes hit barriers in the same order, so a class-level
+        # sequence number is stable where id(self) would not be.
+        CommunicatorBase._barrier_seq += 1
+        multihost_utils.sync_global_devices(
+            f"chainermn_tpu_barrier_{CommunicatorBase._barrier_seq}"
+        )
 
     # ------------------------------------------------------------------
-    def split(self, axes: Sequence[str]) -> "CommunicatorBase":
-        """Sub-communicator over a subset of mesh axes.
+    def split(self, color_or_axes, key: int = 0):
+        """Sub-communicator: ``MPI_Comm_split`` in both of its shapes.
 
-        The structural analogue of ``MPI_Comm_split``
-        (REF:chainermn/communicators/mpi_communicator_base.py ``split``): a
-        DP+PP run builds a mesh with ('data','pp') axes and splits per-axis
-        sub-communicators from it, as the reference's seq2seq+DP examples
-        split MPI_COMM_WORLD.
+        ``split(color, key=...)`` — the reference's arbitrary-subgroup
+        semantics (REF:chainermn/communicators/mpi_communicator_base.py
+        ``split(color, key)``): every member process calls with ITS color
+        and key; processes sharing a color form a new communicator whose
+        ranks are ordered by ``(key, old_rank)``.  ``color=None`` is
+        MPI_UNDEFINED — the process participates in the split but gets
+        ``None`` back.  The sub-communicator's mesh holds only the member
+        processes' devices (inter = members, intra = local devices), and
+        its object plane is namespaced to the subgroup.
 
-        Variants whose collective pattern needs both ``inter`` and ``intra``
-        axes (hierarchical, two_dimensional) degrade to the flat
-        single-collective communicator when split down to one axis — the
-        same thing the reference's sub-communicators do, since a split MPI
-        comm loses the node hierarchy too.
+        ``split(('intra',))`` — axis shape: a sub-communicator over a
+        subset of THIS mesh's axes (a DP+PP run splitting per-axis
+        sub-communicators, as the reference's seq2seq+DP examples split
+        MPI_COMM_WORLD).  Variants whose collective pattern needs both
+        ``inter`` and ``intra`` (hierarchical, two_dimensional) degrade to
+        the flat single-collective communicator when split to one axis —
+        as the reference's sub-communicators lose the node hierarchy too.
         """
+        if color_or_axes is None or isinstance(
+            color_or_axes, (int, np.integer)
+        ):
+            return self._split_color(color_or_axes, key)
+        return self._split_axes(tuple(color_or_axes))
+
+    def _split_axes(self, axes: tuple) -> "CommunicatorBase":
+        # A failed variant construction may already have advanced the
+        # SPMD plane ordinal; restore it so the degrade retry lands on
+        # the SAME ordinal on every process.
+        count = CommunicatorBase._plane_count
         try:
             return type(self)(
-                self.mesh, axes=tuple(axes),
+                self.mesh, axes=axes,
                 allreduce_grad_dtype=self.allreduce_grad_dtype,
+                host_members=self._hp_members,
             )
         except ValueError:
+            CommunicatorBase._plane_count = count
             from .xla_ici import XlaIciCommunicator
 
             return XlaIciCommunicator(
-                self.mesh, axes=tuple(axes),
+                self.mesh, axes=axes,
                 allreduce_grad_dtype=self.allreduce_grad_dtype,
+                host_members=self._hp_members,
+            )
+
+    def split_devices(self, colors, keys=None) -> dict:
+        """Device-plane ``MPI_Comm_split``: partition THIS communicator's
+        DEVICES into sub-communicators by color.
+
+        Single-controller form of the reference's arbitrary-subgroup
+        split: one process speaks for all its devices, so instead of "each
+        rank passes its color" the caller passes ``colors`` — a sequence
+        of length ``device_size`` indexed by flat device rank (row-major
+        over ``self.axes``, i.e. :meth:`device_for_rank` order) — and
+        receives ``{color: communicator}`` covering every color at once.
+        ``keys`` (same length) orders each subgroup (ties by old rank);
+        ``None`` colors are MPI_UNDEFINED (device in no subgroup).  This
+        expresses what the axis split cannot: "every 4th device", or a
+        data-parallel subgroup inside one pipeline stage.
+
+        Each sub-communicator's mesh is 1-D over its devices (axis
+        ``intra`` — one collective leg, ICI-resident when the devices
+        share a host).  A color whose devices span processes gets those
+        processes as its host plane; a color with no devices on THIS
+        process maps to ``None`` (MPI_COMM_NULL).
+        """
+        n = self.device_size
+        colors = list(colors)
+        if len(colors) != n:
+            raise ValueError(
+                f"colors must have length device_size={n}, got {len(colors)}"
+            )
+        keys = list(keys) if keys is not None else [0] * n
+        if len(keys) != n:
+            raise ValueError(
+                f"keys must have length device_size={n}, got {len(keys)}"
+            )
+        groups: dict = {}
+        for r in range(n):
+            if colors[r] is None:
+                continue
+            groups.setdefault(colors[r], []).append(
+                (keys[r], r, self.device_for_rank(r))
+            )
+        from .xla_ici import XlaIciCommunicator
+
+        out: dict = {}
+        for c in sorted(groups):  # deterministic construction order (SPMD)
+            lst = sorted(groups[c], key=lambda t: (t[0], t[1]))
+            devs = [d for _k, _r, d in lst]
+            procs = sorted({d.process_index for d in devs})
+            if jax.process_index() not in procs:
+                # MPI_COMM_NULL for this process — but keep the plane
+                # ordinal advancing in lockstep with constructing ranks.
+                CommunicatorBase._plane_count += 1
+                out[c] = None
+                continue
+            submesh = Mesh(
+                np.array(devs, dtype=object), (mesh_utils.AXIS_INTRA,)
+            )
+            out[c] = XlaIciCommunicator(
+                submesh,
+                allreduce_grad_dtype=self.allreduce_grad_dtype,
+                host_members=procs,
+            )
+        return out
+
+    def _split_color(self, color, key: int):
+        """Process-plane MPI_Comm_split.  A collective over THIS
+        communicator: every member must call it (SPMD), colors partition
+        the members, keys order the subgroup (ties by old rank)."""
+        trips = self.allgather_obj(
+            (None if color is None else int(color), int(key), self.rank)
+        )
+        if color is None:
+            # MPI_UNDEFINED: no communicator — but the plane ordinal must
+            # still advance in lockstep with the processes that DO
+            # construct one, or every later communicator's namespace
+            # diverges across processes.
+            CommunicatorBase._plane_count += 1
+            return None
+        mine = sorted(
+            (k, r) for c, k, r in trips if c == int(color)
+        )
+        sub_ranks = [r for _k, r in mine]  # ranks WITHIN this comm
+        # Translate to global process indices (wire identities).
+        to_global = (
+            (lambda r: self._hp_members[r])
+            if self._hp_members is not None
+            else (lambda r: r)
+        )
+        members = [to_global(r) for r in sub_ranks]
+        # Sub-mesh: the member processes' devices from THIS mesh, one
+        # inter row per member (ordered by subgroup rank), intra = each
+        # process's local devices in mesh order.
+        if len(members) == self.size and members == [
+            to_global(r) for r in range(self.size)
+        ]:
+            submesh = self.mesh  # whole group, original order
+        else:
+            rows = []
+            mesh_devs = list(self.mesh.devices.flat)
+            for g in members:
+                row = [d for d in mesh_devs if d.process_index == g]
+                rows.append(row)
+            n_local = len(rows[0])
+            if any(len(r) != n_local for r in rows):
+                raise ValueError(
+                    "split(color) needs equal local device counts across "
+                    f"members; got {[len(r) for r in rows]}"
+                )
+            submesh = Mesh(
+                np.array(rows, dtype=object),
+                (mesh_utils.AXIS_INTER, mesh_utils.AXIS_INTRA),
+            )
+        from .xla_ici import XlaIciCommunicator
+
+        cls = type(self)
+        # Snapshot the plane ordinal: a variant whose constraints the
+        # subgroup shape cannot satisfy may raise AFTER incrementing it,
+        # which would desynchronize this process's ordinals from the
+        # color=None processes that advanced exactly once.
+        count = CommunicatorBase._plane_count
+        try:
+            return cls(
+                submesh,
+                allreduce_grad_dtype=self.allreduce_grad_dtype,
+                host_members=members,
+            )
+        except ValueError:
+            CommunicatorBase._plane_count = count
+            # Variant constraints (e.g. SingleHostCommunicator) that the
+            # subgroup shape cannot satisfy degrade to the flat backend.
+            return XlaIciCommunicator(
+                submesh,
+                allreduce_grad_dtype=self.allreduce_grad_dtype,
+                host_members=members,
             )
 
     def __repr__(self):

@@ -29,8 +29,10 @@ from .base import CommunicatorBase
 class HierarchicalCommunicator(CommunicatorBase):
     name = "hierarchical"
 
-    def __init__(self, mesh=None, axes=None, allreduce_grad_dtype=None):
-        super().__init__(mesh, axes, allreduce_grad_dtype)
+    def __init__(self, mesh=None, axes=None, allreduce_grad_dtype=None,
+                 host_members=None):
+        super().__init__(mesh, axes, allreduce_grad_dtype,
+                         host_members=host_members)
         if mesh_utils.AXIS_INTRA not in self.axes or mesh_utils.AXIS_INTER not in self.axes:
             raise ValueError(
                 "hierarchical communicator needs both 'inter' and 'intra' "

@@ -644,11 +644,33 @@ class ObjectPlane:
     """
 
     def __init__(
-        self, namespace: str, rank: int, size: int, site: str = "<unknown>"
+        self, namespace: str, rank: int, size: int, site: str = "<unknown>",
+        members: "list[int] | None" = None,
     ):
+        """``rank`` is this process's GLOBAL process index (its wire
+        identity: socket endpoints and KV keys are global-rank-keyed).
+        ``members`` — the ordered GLOBAL ranks participating in this plane
+        — makes the plane a subgroup (``split(color, key)``); public
+        root/dest/source arguments are then SUBGROUP ranks, translated
+        through ``members``.  Default: the full world, identity order.
+        Disjoint subgroups may share a namespace safely: every key and
+        frame route embeds global ranks, so their key spaces are
+        disjoint by construction."""
         self.namespace = namespace
         self.rank = rank
         self.size = size
+        self.members = list(members) if members is not None else list(
+            range(size)
+        )
+        if len(self.members) != size:
+            raise ValueError(
+                f"members {self.members} inconsistent with size {size}"
+            )
+        if rank not in self.members:
+            raise ValueError(
+                f"global rank {rank} is not a member of {self.members}"
+            )
+        self.sub_rank = self.members.index(rank)
         self.site = site
         self._seq: dict[Any, int] = {}
         self._validated = size == 1
@@ -676,7 +698,7 @@ class ObjectPlane:
         timeout_ms = int(
             _os.environ.get("CHAINERMN_TPU_PLANE_CHECK_TIMEOUT_MS", "60000")
         )
-        key = f"{_PREFIX}/planecheck/{self.namespace}/0"
+        key = f"{_PREFIX}/planecheck/{self.namespace}/{self.members[0]}"
         try:
             root_site = _blocking_get(
                 client().blocking_key_value_get, key,
@@ -737,14 +759,15 @@ class ObjectPlane:
 
     def send(self, obj, dest: int, tag: int = 0) -> None:
         self._ensure_validated()
-        slot = ("p2p", self.rank, dest, tag)
+        gdest = self.members[dest]
+        slot = ("p2p", self.rank, gdest, tag)
         if self._use_sockets:
             socket_plane(self.rank).send(
-                self.namespace, dest, tag, self._peek(slot), obj
+                self.namespace, gdest, tag, self._peek(slot), obj
             )
         else:
             put_payload(
-                self._key("p2p", self.rank, dest, tag, self._peek(slot)),
+                self._key("p2p", self.rank, gdest, tag, self._peek(slot)),
                 obj,
             )
         self._commit(slot)
@@ -753,15 +776,16 @@ class ObjectPlane:
         self, source: int, tag: int = 0, *, timeout_ms: int | None = None
     ):
         self._ensure_validated()
-        slot = ("p2p", source, self.rank, tag)
+        gsrc = self.members[source]
+        slot = ("p2p", gsrc, self.rank, tag)
         if self._use_sockets:
             obj = socket_plane(self.rank).recv(
-                self.namespace, source, tag, self._peek(slot),
+                self.namespace, gsrc, tag, self._peek(slot),
                 timeout_ms=timeout_ms,
             )
         else:
             key = self._key(
-                "p2p", source, self.rank, tag, self._peek(slot)
+                "p2p", gsrc, self.rank, tag, self._peek(slot)
             )
             obj, n = get_payload(key, timeout_ms=timeout_ms)
             delete(key, n)  # sole reader
@@ -771,9 +795,10 @@ class ObjectPlane:
     # -- collectives ---------------------------------------------------
     def bcast(self, obj, root: int):
         self._ensure_validated()
-        slot = ("bcast", root)
-        key = self._key("bcast", root, self._peek(slot))
-        if self.rank == root:
+        groot = self.members[root]
+        slot = ("bcast", groot)
+        key = self._key("bcast", groot, self._peek(slot))
+        if self.rank == groot:
             put_payload(key, obj)
             self._commit(slot)
             return obj
@@ -788,15 +813,48 @@ class ObjectPlane:
         base = self._key("gather", self._peek(slot))
         put_payload(f"{base}/{self.rank}", obj)
         out = []
-        for r in range(self.size):
-            if r == self.rank:
+        for g in self.members:
+            if g == self.rank:
                 out.append(obj)
                 continue
-            got, n = get_payload(f"{base}/{r}")
+            got, n = get_payload(f"{base}/{g}")
             out.append(got)
-            ack_and_collect(f"{base}/{r}", n, self.size - 1)
+            ack_and_collect(f"{base}/{g}", n, self.size - 1)
         self._commit(slot)
         return out
+
+    def gather(self, obj, root: int) -> "list | None":
+        """Point-to-root gather (the reference ``MPI_Gather`` wire
+        profile): every non-root sends its payload ONLY to root — O(n *
+        payload) total wire, and non-root processes fetch NOTHING — where
+        :meth:`allgather` costs O(n^2) total.  Returns the subgroup-
+        ordered list at root, None elsewhere.  p2p-shaped, so payloads
+        ride the socket data plane in a dedicated route namespace."""
+        self._ensure_validated()
+        groot = self.members[root]
+        slot = ("pgather", groot)
+        seq = self._peek(slot)
+        ns = f"{self.namespace}#gather{groot}"
+        if self.rank == groot:
+            out = []
+            for g in self.members:
+                if g == groot:
+                    out.append(obj)
+                elif self._use_sockets:
+                    out.append(socket_plane(self.rank).recv(ns, g, 0, seq))
+                else:
+                    key = self._key("pgather", groot, g, seq)
+                    got, n = get_payload(key)
+                    delete(key, n)  # sole reader
+                    out.append(got)
+            self._commit(slot)
+            return out
+        if self._use_sockets:
+            socket_plane(self.rank).send(ns, groot, 0, seq, obj)
+        else:
+            put_payload(self._key("pgather", groot, self.rank, seq), obj)
+        self._commit(slot)
+        return None
 
     def scatter(self, objs, root: int):
         """Point-to-point scatter: root sends each rank exactly its element
@@ -809,29 +867,30 @@ class ObjectPlane:
         (the role of MPI's per-context internal tags); KV keys are the
         socket-less fallback."""
         self._ensure_validated()
-        slot = ("scatter", root)
+        groot = self.members[root]
+        slot = ("scatter", groot)
         seq = self._peek(slot)
-        ns = f"{self.namespace}#scatter{root}"
-        if self.rank == root:
+        ns = f"{self.namespace}#scatter{groot}"
+        if self.rank == groot:
             if objs is None or len(objs) != self.size:
                 raise ValueError(
                     f"scatter_obj needs a length-{self.size} list at root"
                 )
-            for r in range(self.size):
-                if r == root:
+            for i, g in enumerate(self.members):
+                if g == groot:
                     continue
                 if self._use_sockets:
-                    socket_plane(self.rank).send(ns, r, 0, seq, objs[r])
+                    socket_plane(self.rank).send(ns, g, 0, seq, objs[i])
                 else:
                     put_payload(
-                        self._key("scatter", root, r, seq), objs[r]
+                        self._key("scatter", groot, g, seq), objs[i]
                     )
             self._commit(slot)
-            return objs[root]
+            return objs[self.sub_rank]
         if self._use_sockets:
-            obj = socket_plane(self.rank).recv(ns, root, 0, seq)
+            obj = socket_plane(self.rank).recv(ns, groot, 0, seq)
         else:
-            key = self._key("scatter", root, self.rank, seq)
+            key = self._key("scatter", groot, self.rank, seq)
             obj, n = get_payload(key)
             delete(key, n)  # sole reader
         self._commit(slot)

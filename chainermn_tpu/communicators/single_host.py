@@ -20,8 +20,10 @@ from .base import CommunicatorBase
 class SingleHostCommunicator(CommunicatorBase):
     name = "single_host"
 
-    def __init__(self, mesh=None, axes=None, allreduce_grad_dtype=None):
-        super().__init__(mesh, axes, allreduce_grad_dtype)
+    def __init__(self, mesh=None, axes=None, allreduce_grad_dtype=None,
+                 host_members=None):
+        super().__init__(mesh, axes, allreduce_grad_dtype,
+                         host_members=host_members)
         if self.inter_size != 1 and mesh_utils.AXIS_INTER in self.axes:
             raise ValueError(
                 "single_host communicator requires inter_size == 1 "

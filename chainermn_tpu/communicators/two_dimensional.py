@@ -32,8 +32,10 @@ from .xla_ici import pack
 class TwoDimensionalCommunicator(CommunicatorBase):
     name = "two_dimensional"
 
-    def __init__(self, mesh=None, axes=None, allreduce_grad_dtype=None):
-        super().__init__(mesh, axes, allreduce_grad_dtype)
+    def __init__(self, mesh=None, axes=None, allreduce_grad_dtype=None,
+                 host_members=None):
+        super().__init__(mesh, axes, allreduce_grad_dtype,
+                         host_members=host_members)
         if mesh_utils.AXIS_INTRA not in self.axes or mesh_utils.AXIS_INTER not in self.axes:
             raise ValueError(
                 "two_dimensional communicator needs both 'inter' and 'intra' "

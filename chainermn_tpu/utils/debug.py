@@ -119,7 +119,23 @@ class CollectiveTrace:
         fp = self.fingerprint()
         fps = self._comm.gather_obj(fp)
         if len(set(fps)) > 1:
-            logs = self._comm.gather_obj(self._sym)
+            # The full symbolic logs are bulky and only the diagnosis
+            # needs them: point-to-root gather (MPI_Gather wire profile —
+            # non-root ranks ship their log to rank 0 and fetch nothing).
+            # Coordination-service-less runs keep the old symmetric
+            # allgather: the diagnostic must never be masked by a
+            # transport requirement.
+            from chainermn_tpu.communicators import kvtransport
+
+            if kvtransport.available():
+                logs = self._comm.gather_obj(self._sym, root=0)
+            else:
+                logs = self._comm.gather_obj(self._sym)
+            if logs is None or self._comm.rank != 0:
+                raise RuntimeError(
+                    f"collective order mismatch across hosts: fingerprints "
+                    f"{fps}; rank 0 holds the first differing call"
+                )
             first_diff = None
             for i in range(max(len(l) for l in logs)):
                 entries = {

@@ -105,8 +105,15 @@ def main(argv=None):
     dp = comm.inter_size
     pp = comm.intra_size
     dp_comm = comm.split(("inter",))  # data-parallel sub-communicator
+    # Arbitrary-subgroup split (MPI_Comm_split(color, key) shape): one
+    # data-parallel subgroup PER PIPELINE STAGE — the devices at intra
+    # position s across all inter rows.  Stage s's grads could be
+    # averaged on stage_dp[s] alone; here they sanity-check the topology.
+    stage_dp = comm.split_devices([r % pp for r in range(comm.device_size)])
+    assert all(sub.device_size == dp for sub in stage_dp.values())
     if comm.rank == 0:
-        print(f"mesh: data={dp} x pipeline={pp}; "
+        print(f"mesh: data={dp} x pipeline={pp} "
+              f"(+{len(stage_dp)} per-stage DP subgroups); "
               f"double_buffering={not args.no_double_buffering}")
 
     shape = (args.image_size, args.image_size, 3)

@@ -292,6 +292,60 @@ def main():
     np.testing.assert_allclose(zw, ref_w, rtol=1e-5, atol=1e-6)
     assert np.isfinite(float(zloss))
 
+    from jax import lax
+
+    # MPI_Comm_split(color, key) across REAL process boundaries
+    # (REF:chainermn/communicators/mpi_communicator_base.py split).
+    # Disjoint colors: every process its own singleton subgroup whose
+    # mesh holds ONLY its local devices.
+    solo = comm.split(pid)
+    assert solo.size == 1 and solo.rank == 0
+    assert solo.device_size == ndev
+    assert all(
+        d.process_index == pid for d in solo.mesh.devices.flat
+    )
+    # Same color, reversed keys: subgroup rank order flips.
+    rev = comm.split(0, key=nproc - pid)
+    assert rev.size == nproc
+    assert rev.rank == nproc - 1 - pid, (rev.rank, pid)
+    # Subgroup object plane: root is the subgroup's rank 0 = global
+    # LAST process; payload visible to all members.
+    got = rev.bcast_obj(("from", pid) if rev.rank == 0 else None, root=0)
+    assert got == ("from", nproc - 1), got
+    # Subgroup allgather is ordered by subgroup rank (key order).
+    ag = rev.allgather_obj(pid)
+    assert ag == list(range(nproc))[::-1], ag
+    # Point-to-root gather_obj: list at root only, None elsewhere.
+    g = rev.gather_obj(f"p{pid}", root=0)
+    if rev.rank == 0:
+        assert g == [f"p{r}" for r in reversed(range(nproc))], g
+    else:
+        assert g is None
+    rev.barrier()
+    # Subgroup DEVICE plane: the sub-mesh's inter rows follow key order
+    # (last process first); a psum over it must still see every device.
+    tot = jax.jit(rev.shard_map(
+        lambda x: lax.psum(x, rev.axes),
+        in_specs=(rev._world_spec,), out_specs=jax.sharding.PartitionSpec(),
+    ))(jax.make_array_from_callback(
+        (rev.device_size,),
+        NamedSharding(rev.mesh, rev._world_spec),
+        lambda idx: np.arange(float(rev.device_size), dtype=np.float32)[idx],
+    ))
+    np.testing.assert_allclose(
+        float(tot.addressable_shards[0].data.reshape(-1)[0]),
+        sum(range(rev.device_size)),
+    )
+    # MPI_UNDEFINED on one process only: plane ordinals stay in lockstep,
+    # so a later world communicator still lines up across processes.
+    maybe = comm.split(0 if pid == 0 else None)
+    if pid == 0:
+        assert maybe.size == 1
+    else:
+        assert maybe is None
+    after = create_communicator("naive")
+    assert after.bcast_obj({"post": "split"}, root=0)["post"] == "split"
+
     print(f"MP_WORKER_OK {pid}", flush=True)
 
 

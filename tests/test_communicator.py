@@ -548,3 +548,65 @@ def test_device_for_rank_matches_axis_index(mesh):
     for shard in ranks.addressable_shards:
         r = int(np.asarray(shard.data).item())
         assert shard.device == comm.device_for_rank(r), (r, shard.device)
+
+
+# ---------------------------------------------------------------------------
+# MPI_Comm_split: arbitrary subgroups (process plane + device plane)
+# ---------------------------------------------------------------------------
+
+
+def test_split_devices_arbitrary_subsets(devices8):
+    """Device-plane split expresses what the axis split cannot: 'every
+    4th device' subgroups, each a working communicator over only its own
+    devices."""
+    mesh = build_mesh(inter_size=2, intra_size=4, devices=devices8)
+    comm = create_communicator("naive", mesh=mesh)
+    subs = comm.split_devices([r % 4 for r in range(8)])
+    assert sorted(subs) == [0, 1, 2, 3]
+    for c, sub in subs.items():
+        assert sub.device_size == 2
+        got = {d.id for d in sub.mesh.devices.flat}
+        want = {devices8[c].id, devices8[c + 4].id}
+        assert got == want, (c, got, want)
+
+
+def test_split_devices_dp_subgroup_within_stage(devices8):
+    """A data-parallel subgroup inside one pipeline stage: psum runs over
+    ONLY the stage's devices."""
+    mesh = build_mesh(inter_size=2, intra_size=4, devices=devices8)
+    comm = create_communicator("naive", mesh=mesh)
+    stages = comm.split_devices([r // 4 for r in range(8)])
+    for c, sub in stages.items():
+        f = jax.jit(sub.shard_map(
+            lambda x: jax.lax.psum(x, sub.axes),
+            in_specs=(sub._world_spec,), out_specs=P(),
+        ))
+        out = f(jnp.arange(float(sub.device_size)))
+        assert float(np.asarray(out)[0]) == sum(range(sub.device_size))
+
+
+def test_split_devices_keys_and_undefined(devices8):
+    """keys order the subgroup (ties by old rank); None colors are
+    MPI_UNDEFINED; wrong-length args raise."""
+    mesh = build_mesh(inter_size=1, intra_size=8, devices=devices8)
+    comm = create_communicator("naive", mesh=mesh)
+    rev = comm.split_devices([0] * 8, keys=list(range(8))[::-1])[0]
+    assert [d.id for d in rev.mesh.devices.flat] == [
+        d.id for d in reversed(devices8)
+    ]
+    subs = comm.split_devices([0, None, None, None, None, None, None, 0])
+    assert list(subs) == [0] and subs[0].device_size == 2
+    with pytest.raises(ValueError, match="length"):
+        comm.split_devices([0, 1])
+    with pytest.raises(ValueError, match="length"):
+        comm.split_devices([0] * 8, keys=[0])
+
+
+def test_split_color_single_process(mesh):
+    """Process-plane split(color, key) in a single-process world: same
+    color returns a whole-world communicator, None is MPI_UNDEFINED."""
+    comm = create_communicator("naive", mesh=mesh)
+    sub = comm.split(7, key=3)
+    assert sub.size == 1 and sub.rank == 0
+    assert sub.device_size == comm.device_size
+    assert comm.split(None) is None
