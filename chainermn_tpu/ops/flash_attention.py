@@ -22,9 +22,13 @@ forward ~67 TFLOP/s (4.5-4.9x XLA's materialized-logits attention),
 forward+backward 4.4x, backward alone ~81 TFLOP/s — at the chip's own
 sustained matmul roofline — with O(S) memory in both passes.
 
+Optional segment-id masks support packed-sequence training: tokens attend
+only within their own segment, and padding rows produce zero output and
+zero gradients in both passes.
+
 Falls back to interpreter mode off-TPU (tests run the same kernel code on
 the CPU mesh) and to plain XLA attention for shapes the kernel does not
-cover (head_dim > 128 or unaligned sequence lengths).
+cover (head_dim > 256 or unaligned sequence lengths).
 """
 
 from __future__ import annotations
@@ -47,10 +51,31 @@ except ImportError:  # pragma: no cover
 _NEG_INF = -1e30
 
 
+def _block_mask(shape, causal, q_start, k_start, qs_ref, ks_ref):
+    """Combined (block_q, block_k) boolean mask for one grid tile — the
+    causal triangle AND segment-id equality (packed sequences attend only
+    within their own segment).  None when nothing masks."""
+    m = None
+    if causal:
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+        m = q_pos >= k_pos
+    if qs_ref is not None:
+        seg = qs_ref[0] == ks_ref[0].reshape(1, -1)   # (bq,1) == (1,bk)
+        m = seg if m is None else (m & seg)
+    return m
+
+
 def _attn_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, scale: float, causal: bool, block_q: int, block_k: int,
+    *refs,
+    scale: float, causal: bool, segmented: bool, block_q: int, block_k: int,
 ):
+    if segmented:
+        (q_ref, k_ref, v_ref, qs_ref, ks_ref,
+         o_ref, lse_ref, acc_ref, m_ref, l_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+        qs_ref = ks_ref = None
     iq = pl.program_id(1)
     ik = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -79,15 +104,21 @@ def _attn_kernel(
         v = v_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
 
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        mask = _block_mask(s.shape, causal, q_start, k_start, qs_ref, ks_ref)
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[:, 0]
         m_blk = jnp.max(s, axis=1)
         m_new = jnp.maximum(m_prev, m_blk)
         p = jnp.exp(s - m_new[:, None])
+        if segmented:
+            # A row fully masked in this block has m_new == _NEG_INF ==
+            # its masked scores, making exp(s - m_new) = 1 — zero those
+            # entries so padding rows accumulate nothing.  (Causal-only
+            # running blocks always have >= 1 valid entry per row, so the
+            # unsegmented kernel never hits this.)
+            p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
 
         l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
@@ -105,14 +136,19 @@ def _attn_kernel(
         lse_ref[0] = (m_ref[:, 0] + jnp.log(denom))[:, None]
 
 
-def _flash_bh_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
-    """(BH, S, D) flash attention forward; returns (o, lse)."""
+def _flash_bh_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret,
+                  q_seg=None, kv_seg=None):
+    """(BH, S, D) flash attention forward; returns (o, lse).
+
+    ``q_seg``/``kv_seg``: optional (BH, S, 1) int32 segment ids for packed
+    sequences — attention is masked to segment-id equality."""
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     grid = (BH, Sq // block_q, Sk // block_k)
+    segmented = q_seg is not None
 
     kernel = functools.partial(
-        _attn_kernel, scale=scale, causal=causal,
+        _attn_kernel, scale=scale, causal=causal, segmented=segmented,
         block_q=block_q, block_k=block_k,
     )
     scratch = [
@@ -120,6 +156,18 @@ def _flash_bh_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
         pltpu.VMEM((block_q, 1), jnp.float32),
         pltpu.VMEM((block_q, 1), jnp.float32),
     ]
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [q, k, v]
+    if segmented:
+        in_specs += [
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, 1), lambda b, i, j: (b, j, 0)),
+        ]
+        args += [q_seg, kv_seg]
     return pl.pallas_call(
         kernel,
         out_shape=[
@@ -127,24 +175,27 @@ def _flash_bh_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((BH, Sq, 1), jnp.float32),
         ],
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         scratch_shapes=scratch,
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-    *, scale: float, causal: bool, block_q: int, block_k: int,
+    *refs,
+    scale: float, causal: bool, segmented: bool, block_q: int, block_k: int,
 ):
+    if segmented:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
+         dq_ref, dq_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_acc) = refs
+        qs_ref = ks_ref = None
     iq = pl.program_id(1)
     ik = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -166,11 +217,14 @@ def _dq_kernel(
         v = v_ref[0]
         do = do_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        mask = _block_mask(s.shape, causal, q_start, k_start, qs_ref, ks_ref)
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0, :, :])             # exact probabilities
+        if segmented:
+            # A FULLY-masked row (padding) has lse ~ _NEG_INF, making
+            # exp(s - lse) = 1 at masked entries; zero them explicitly.
+            p = jnp.where(mask, p, 0.0)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta_ref[0, :, :]) * scale).astype(k.dtype)
         dq_acc[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
@@ -181,10 +235,16 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc,
-    *, scale: float, causal: bool, block_q: int, block_k: int,
+    *refs,
+    scale: float, causal: bool, segmented: bool, block_q: int, block_k: int,
 ):
+    if segmented:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        qs_ref = ks_ref = None
     ik = pl.program_id(1)   # grid: (BH, n_k, n_q) — q innermost
     iq = pl.program_id(2)
     n_q = pl.num_programs(2)
@@ -208,11 +268,12 @@ def _dkv_kernel(
         v = v_ref[0]
         do = do_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        mask = _block_mask(s.shape, causal, q_start, k_start, qs_ref, ks_ref)
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0, :, :])
+        if segmented:
+            p = jnp.where(mask, p, 0.0)  # see _dq_kernel
         pt = p.astype(do.dtype).T
         dv_acc[:] += jnp.dot(pt, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
@@ -226,7 +287,7 @@ def _dkv_kernel(
 
 
 def _flash_bh_bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
-                  interpret, dlse=None):
+                  interpret, dlse=None, q_seg=None, kv_seg=None):
     """(BH, S, D) flash attention backward: (dq, dk, dv).
 
     ``dlse``: optional cotangent of the row log-sum-exp output (used when
@@ -236,6 +297,7 @@ def _flash_bh_bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
     """
     BH, Sq, D = q.shape
     Sk = k.shape[1]
+    segmented = q_seg is not None
     # delta_i = rowsum(dO ∘ O) — cheap elementwise, XLA handles it.
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
@@ -243,29 +305,45 @@ def _flash_bh_bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
     if dlse is not None:
         delta = delta - dlse.astype(jnp.float32)[..., None]
 
+    q_spec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
+    k_spec = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
+    r_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+    dq_in = [q_spec, k_spec, k_spec, q_spec, r_spec, r_spec]
+    dq_args = [q, k, v, do, lse, delta]
+    if segmented:
+        dq_in += [
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, 1), lambda b, i, j: (b, j, 0)),
+        ]
+        dq_args += [q_seg, kv_seg]
     dq = pl.pallas_call(
         functools.partial(
-            _dq_kernel, scale=scale, causal=causal,
+            _dq_kernel, scale=scale, causal=causal, segmented=segmented,
             block_q=block_q, block_k=block_k,
         ),
         out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
         grid=(BH, Sq // block_q, Sk // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-        ],
+        in_specs=dq_in,
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dq_args)
 
+    # dkv grid transposes the block walk: (BH, n_k, n_q), q innermost.
+    qT_spec = pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0))
+    kT_spec = pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0))
+    rT_spec = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
+    dkv_in = [qT_spec, kT_spec, kT_spec, qT_spec, rT_spec, rT_spec]
+    dkv_args = [q, k, v, do, lse, delta]
+    if segmented:
+        dkv_in += [
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, 1), lambda b, j, i: (b, j, 0)),
+        ]
+        dkv_args += [q_seg, kv_seg]
     dk, dv = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, scale=scale, causal=causal,
+            _dkv_kernel, scale=scale, causal=causal, segmented=segmented,
             block_q=block_q, block_k=block_k,
         ),
         out_shape=[
@@ -273,14 +351,7 @@ def _flash_bh_bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
             jax.ShapeDtypeStruct((BH, Sk, D), v.dtype),
         ],
         grid=(BH, Sk // block_k, Sq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
-        ],
+        in_specs=dkv_in,
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
@@ -290,7 +361,7 @@ def _flash_bh_bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dkv_args)
     return dq, dk, dv
 
 
@@ -323,6 +394,49 @@ def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, do):
 
 
 _flash_bh.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def _float0_like(x):
+    """Cotangent for integer primal inputs (jax's float0 convention)."""
+    return np.zeros(x.shape, dtype=jax.dtypes.float0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_bh_seg(q, k, v, q_seg, kv_seg, scale, causal, block_q, block_k,
+                  interpret):
+    """Segment-masked (BH, S, D) flash attention (packed sequences):
+    tokens attend only within their own segment id.  Same explicit
+    FlashAttention-2 backward; fully-masked (padding) rows produce zero
+    output and zero gradients."""
+    o, _ = _flash_bh_fwd(
+        q, k, v, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+        q_seg=q_seg, kv_seg=kv_seg,
+    )
+    return o
+
+
+def _flash_seg_vjp_fwd(q, k, v, q_seg, kv_seg, scale, causal, block_q,
+                       block_k, interpret):
+    o, lse = _flash_bh_fwd(
+        q, k, v, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+        q_seg=q_seg, kv_seg=kv_seg,
+    )
+    return o, (q, k, v, o, lse, q_seg, kv_seg)
+
+
+def _flash_seg_vjp_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse, q_seg, kv_seg = res
+    dq, dk, dv = _flash_bh_bwd(
+        q, k, v, o, lse, do, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+        q_seg=q_seg, kv_seg=kv_seg,
+    )
+    return dq, dk, dv, _float0_like(q_seg), _float0_like(kv_seg)
+
+
+_flash_bh_seg.defvjp(_flash_seg_vjp_fwd, _flash_seg_vjp_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -361,15 +475,27 @@ def _flash_lse_vjp_bwd(scale, causal, block_q, block_k, interpret, res, cots):
 flash_attention_with_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 
-def _xla_attention(q, k, v, scale, causal):
+def _xla_attention(q, k, v, scale, causal, q_segment_ids=None,
+                   kv_segment_ids=None):
     logits = jnp.einsum(
         "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
     ) * scale
+    Sq, Sk = q.shape[1], k.shape[1]
+    mask = None
     if causal:
-        Sq, Sk = q.shape[1], k.shape[1]
-        mask = jnp.tril(jnp.ones((Sq, Sk), bool))
-        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+        mask = jnp.tril(jnp.ones((Sq, Sk), bool))[None]
+    if q_segment_ids is not None:
+        seg = q_segment_ids[:, :, None] == kv_segment_ids[:, None, :]
+        mask = seg if mask is None else (mask & seg)
+    if mask is not None:
+        logits = jnp.where(mask[:, None], logits, _NEG_INF)
     w = jax.nn.softmax(logits)
+    if q_segment_ids is not None:
+        # Fully-masked (padding) rows: softmax of all -inf is uniform
+        # garbage; zero them so output AND gradients vanish, matching the
+        # Pallas kernel's behavior.
+        any_valid = mask.any(axis=-1)  # (B, Sq)
+        w = jnp.where(any_valid[:, None, :, None], w, 0.0)
     return jnp.einsum("bhqk,bkhd->bqhd", w, v.astype(jnp.float32)).astype(q.dtype)
 
 
@@ -382,14 +508,24 @@ def flash_attention(
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
+    q_segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
 ):
     """Flash attention over (B, S, H, D) tensors (layout matches the
     transformer layers in ``chainermn_tpu.models``).
 
-    Uses the Pallas kernel when shapes allow (D ≤ 128, S divisible by the
+    Uses the Pallas kernel when shapes allow (D ≤ 256, S divisible by the
     block sizes after clamping); otherwise falls back to XLA attention.
-    The compiled path handles any D ≤ 128 (Mosaic pads the lane dim;
-    verified D ∈ {16..128} on a v5e-class chip against the XLA oracle).
+    The compiled path handles any D ≤ 256 (Mosaic pads the lane dim;
+    verified on a v5e-class chip against the XLA oracle at D ∈ {16..128}
+    and at the wide-head points D ∈ {160, 192, 256}).
+
+    ``q_segment_ids``/``kv_segment_ids``: optional (B, S) int32 segment
+    ids for PACKED sequences — tokens attend only within their own
+    segment (combined with the causal mask), the packed-long-context
+    training shape.  Rows whose segment matches nothing (padding, e.g.
+    segment id -1 against all-nonnegative kv ids) produce zero output
+    and zero gradients.
 
     ``block_q``/``block_k`` default to an auto size, ``S/16`` clamped to
     [128, 512] — measured optimal per length on a v5e-class chip
@@ -401,6 +537,10 @@ def flash_attention(
     Sk = k.shape[1]
     if scale is None:
         scale = 1.0 / (D**0.5)
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError(
+            "q_segment_ids and kv_segment_ids must be passed together"
+        )
 
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
@@ -431,21 +571,42 @@ def flash_attention(
     tile_ok = interpret or (
         block_q % sublane == 0 and block_k % sublane == 0
     )
+    # Wide heads: Mosaic pads the lane dim, so any D ≤ 256 compiles
+    # (verified on-chip at D ∈ {160, 192, 256} against the oracle);
+    # beyond 256 the VMEM block economics favor the XLA fallback.
+    d_ok = D <= 256
     usable = (
         _HAS_PLTPU
-        and D <= 128
+        and d_ok
         and Sq % block_q == 0
         and Sk % block_k == 0
         and tile_ok
     )
     if not usable:
-        return _xla_attention(q, k, v, scale, causal)
+        return _xla_attention(
+            q, k, v, scale, causal,
+            q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
+        )
 
     # (B, S, H, D) → (B*H, S, D)
     qt = q.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)
     kt = k.transpose(0, 2, 1, 3).reshape(B * H, Sk, D)
     vt = v.transpose(0, 2, 1, 3).reshape(B * H, Sk, D)
-    out = _flash_bh(qt, kt, vt, scale, causal, block_q, block_k, interpret)
+    if q_segment_ids is not None:
+        # (B, S) → (B*H, S, 1): head index is minor in the BH flattening.
+        qs = jnp.repeat(
+            q_segment_ids.astype(jnp.int32), H, axis=0
+        )[..., None]
+        ks = jnp.repeat(
+            kv_segment_ids.astype(jnp.int32), H, axis=0
+        )[..., None]
+        out = _flash_bh_seg(
+            qt, kt, vt, qs, ks, scale, causal, block_q, block_k, interpret
+        )
+    else:
+        out = _flash_bh(
+            qt, kt, vt, scale, causal, block_q, block_k, interpret
+        )
     return out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
 
 
@@ -453,7 +614,7 @@ def flash_block_plan(S: int, D: int, dtype, interpret: bool):
     """(usable, block_size) for running the kernel over length-``S``
     chunks — the single block-policy used by composition layers
     (ring/zigzag).  Mirrors :func:`flash_attention`'s gating: pallas-TPU
-    importable, D ≤ 128 compiled, blocks always DIVIDING S (a
+    importable, D ≤ 256 compiled, blocks always DIVIDING S (a
     non-dividing block floors the grid and silently drops tail rows —
     interpret mode included), sized near the measured-optimal S/16
     clamped to [128, 512]."""
@@ -474,7 +635,7 @@ def flash_block_plan(S: int, D: int, dtype, interpret: bool):
         if b * 64 < S:
             return False, 0
         return True, b
-    if D > 128:
+    if D > 256:
         return False, 0
     target = int(np.clip(S // 16, 128, 512))
     cands = [b for b in (128, 256, 512) if S % b == 0]
@@ -498,12 +659,27 @@ def from_bh(x, B: int, H: int):
     return x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
 
 
-def make_flash_attention_fn(causal: bool = True):
+def make_flash_attention_fn(causal: bool = True, q_segment_ids=None,
+                            kv_segment_ids=None):
     """Adapter for the transformer layers' ``attention_fn`` slot (mask
-    argument ignored; causality is the kernel's)."""
+    argument ignored; causality is the kernel's).
+
+    ``q_segment_ids``/``kv_segment_ids`` (optional, (B, S) int32) bind
+    packed-sequence segment masks at CONSTRUCTION — the layers call
+    ``attention_fn(q, k, v, mask)``, so per-batch metadata enters as a
+    closure (sliced to the local batch under data-parallel sharding)."""
 
     def fn(q, k, v, mask=None):
         del mask
-        return flash_attention(q, k, v, causal=causal)
+        qs = ks = None
+        if q_segment_ids is not None:
+            qs = q_segment_ids[: q.shape[0]]
+            ks = (
+                kv_segment_ids if kv_segment_ids is not None else
+                q_segment_ids
+            )[: k.shape[0]]
+        return flash_attention(
+            q, k, v, causal=causal, q_segment_ids=qs, kv_segment_ids=ks,
+        )
 
     return fn

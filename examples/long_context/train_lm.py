@@ -76,6 +76,10 @@ def main(argv=None):
     p.add_argument("--checkpoint-every", type=int, default=10,
                    help="save a generation every N steps")
     p.add_argument("--checkpoint-name", default="long_context")
+    p.add_argument("--packed", action="store_true",
+                   help="packed-sequence training: two documents per row, "
+                   "flash attention masked by segment ids so tokens never "
+                   "attend across document boundaries (sp=none + flash)")
     args = p.parse_args(argv)
 
     comm = chainermn_tpu.create_communicator("xla_ici", inter_size=args.dp)
@@ -83,8 +87,25 @@ def main(argv=None):
     S, B, vocab = args.seq_len, args.batchsize, args.vocab
     dtype = jnp.dtype(args.dtype)
 
+    if args.packed and (args.sp != "none" or args.no_flash):
+        raise SystemExit(
+            "--packed needs the flash kernel's segment masks: use "
+            "--sp none without --no-flash (segment threading through "
+            "ring/zigzag/ulysses is not implemented)"
+        )
+
     if args.sp == "none":
-        attention_fn = None if args.no_flash else make_flash_attention_fn()
+        if args.packed:
+            # Two documents packed per row at the S/2 boundary: segment
+            # ids gate the flash kernel so attention never crosses the
+            # boundary, and positions restart per document.
+            seg_row = (np.arange(S) >= S // 2).astype(np.int32)
+            seg_all = jnp.asarray(np.broadcast_to(seg_row, (B, S)).copy())
+            attention_fn = make_flash_attention_fn(
+                q_segment_ids=seg_all
+            )
+        else:
+            attention_fn = None if args.no_flash else make_flash_attention_fn()
         sp_ways_eff = 1
     elif args.sp == "ring":
         attention_fn = make_ring_attention_fn("intra")
@@ -141,7 +162,15 @@ def main(argv=None):
               f"flash={args.sp == 'none' and not args.no_flash} "
               f"params={n_params/1e6:.1f}M seq_len={S}")
 
-    denom = B * (S - 1)  # global count of predicted positions
+    # Predicted positions: each packed document loses its final token.
+    denom = B * (S - 2) if args.packed else B * (S - 1)
+    packed_pos = (
+        jnp.asarray(
+            np.concatenate([np.arange(S // 2)] * 2).astype(np.int32)
+        )
+        if args.packed
+        else None
+    )
 
     if args.sp == "none":
         # Pure DP path through the reference-shaped optimizer wrapper.
@@ -149,7 +178,7 @@ def main(argv=None):
 
         def loss_fn(params, batch):
             tok, tgt, wt = batch
-            logits = model.apply(params, tok)
+            logits = model.apply(params, tok, position_offset=packed_pos)
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, tgt)
             # Local mean over this device's (equal-size) share of the
             # predicted positions; the wrapper pmeans across devices.
@@ -214,6 +243,8 @@ def main(argv=None):
     rng = np.random.RandomState(0)
     wt_np = np.ones((B, S), np.float32)
     wt_np[:, -1] = 0.0  # final position has no successor
+    if args.packed:
+        wt_np[:, S // 2 - 1] = 0.0  # first document's final position
     # Zigzag layout: batches are permuted into shard order on the host;
     # targets/weights ride the same permutation (the loss is a positionwise
     # sum, so it is permutation-invariant as long as all three agree).
@@ -245,10 +276,25 @@ def main(argv=None):
     for epoch in range(args.epochs):
         t0, n_tok = time.perf_counter(), 0
         for i in range(args.steps_per_epoch):
-            tok_np = successor_batch(rng, B, S, vocab)
+            # Draw FIRST (the rng stream position is what resume replays),
+            # assemble targets only for steps that actually train.
+            if args.packed:
+                halves = [
+                    successor_batch(rng, B, S // 2, vocab) for _ in range(2)
+                ]
+            else:
+                tok_np = successor_batch(rng, B, S, vocab)
             if epoch * args.steps_per_epoch + i < resume_step:
                 continue  # replayed rng draw; already trained pre-crash
-            tgt_np = np.roll(tok_np, -1, axis=1)
+            if args.packed:
+                # Two independent documents per row; targets roll WITHIN
+                # each document (the boundary position is weight-zeroed).
+                tok_np = np.concatenate(halves, axis=1)
+                tgt_np = np.concatenate(
+                    [np.roll(h, -1, axis=1) for h in halves], axis=1
+                )
+            else:
+                tgt_np = np.roll(tok_np, -1, axis=1)
             tok = jnp.asarray(tok_np[:, perm])
             tgt = jnp.asarray(tgt_np[:, perm])
             carry, last = step(carry, (tok, tgt, wt))

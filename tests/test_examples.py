@@ -113,3 +113,16 @@ def test_long_context_lm_smoke(sp):
         "--layers", "1", "--vocab", "64", "--epochs", "1",
         "--steps-per-epoch", "4", "--dtype", "float32", *extra,
     )
+
+
+@pytest.mark.slow
+def test_long_context_packed_smoke():
+    """Packed-sequence training: segment-masked flash attention, two
+    documents per row, positions restarting at the boundary."""
+    _run(
+        "long_context/train_lm.py",
+        "--packed", "--seq-len", "256", "--batchsize", "8",
+        "--d-model", "32", "--n-heads", "4", "--d-ff", "64",
+        "--layers", "1", "--vocab", "64", "--epochs", "1",
+        "--steps-per-epoch", "4", "--dtype", "float32",
+    )
