@@ -664,20 +664,44 @@ def make_flash_attention_fn(causal: bool = True, q_segment_ids=None,
     """Adapter for the transformer layers' ``attention_fn`` slot (mask
     argument ignored; causality is the kernel's).
 
-    ``q_segment_ids``/``kv_segment_ids`` (optional, (B, S) int32) bind
+    ``q_segment_ids``/``kv_segment_ids`` (optional int32) bind
     packed-sequence segment masks at CONSTRUCTION — the layers call
     ``attention_fn(q, k, v, mask)``, so per-batch metadata enters as a
-    closure (sliced to the local batch under data-parallel sharding)."""
+    closure.  Two shapes are accepted:
+
+    * ``(S,)`` — one row's ids, broadcast to every batch row.  This is
+      the DATA-PARALLEL-SAFE form: under ``shard_map`` the closure is
+      replicated while ``q`` is a local shard, so only row-uniform ids
+      can be correct without knowing which global rows a device holds.
+    * ``(B, S)`` — per-row ids; ``B`` must EQUAL the batch the adapter
+      sees (a mismatch raises rather than silently masking shard 1+ with
+      shard 0's rows)."""
+
+    def _match(ids, batch):
+        if ids.ndim == 1:
+            import jax.numpy as _jnp
+
+            return _jnp.broadcast_to(ids[None], (batch, ids.shape[0]))
+        if ids.shape[0] != batch:
+            raise ValueError(
+                f"segment_ids batch {ids.shape[0]} != attention batch "
+                f"{batch}: under data-parallel sharding the adapter "
+                "cannot know which global rows this shard holds — pass "
+                "row-uniform (S,) ids, or thread per-row ids through "
+                "flash_attention directly inside the sharded region"
+            )
+        return ids
 
     def fn(q, k, v, mask=None):
         del mask
         qs = ks = None
         if q_segment_ids is not None:
-            qs = q_segment_ids[: q.shape[0]]
-            ks = (
-                kv_segment_ids if kv_segment_ids is not None else
-                q_segment_ids
-            )[: k.shape[0]]
+            qs = _match(q_segment_ids, q.shape[0])
+            ks = _match(
+                kv_segment_ids if kv_segment_ids is not None
+                else q_segment_ids,
+                k.shape[0],
+            )
         return flash_attention(
             q, k, v, causal=causal, q_segment_ids=qs, kv_segment_ids=ks,
         )

@@ -99,11 +99,12 @@ def main(argv=None):
             # Two documents packed per row at the S/2 boundary: segment
             # ids gate the flash kernel so attention never crosses the
             # boundary, and positions restart per document.
-            seg_row = (np.arange(S) >= S // 2).astype(np.int32)
-            seg_all = jnp.asarray(np.broadcast_to(seg_row, (B, S)).copy())
-            attention_fn = make_flash_attention_fn(
-                q_segment_ids=seg_all
+            # Row-uniform (S,) ids: the DP-safe adapter form (every row
+            # shares the S/2 boundary, so shards need no row identity).
+            seg_row = jnp.asarray(
+                (np.arange(S) >= S // 2).astype(np.int32)
             )
+            attention_fn = make_flash_attention_fn(q_segment_ids=seg_row)
         else:
             attention_fn = None if args.no_flash else make_flash_attention_fn()
         sp_ways_eff = 1

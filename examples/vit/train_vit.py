@@ -96,7 +96,12 @@ def main(argv=None):
     p.add_argument("--no-double-buffering", action="store_true")
     p.add_argument("--schedule", choices=["gpipe", "1f1b"], default="gpipe",
                    help="pipeline schedule: GPipe (AD backward) or the "
-                   "memory-bounded interleaved 1F1B (explicit backward)")
+                   "memory-bounded 1F1B (explicit backward)")
+    p.add_argument("--virtual-stages", type=int, default=1,
+                   help="model chunks PER pipeline device (interleaved "
+                   "1F1B; total depth = pp * v, bubble cut ~(v+1)/2v of "
+                   "the non-interleaved schedule's; requires --schedule "
+                   "1f1b and microbatches divisible by the pipeline size)")
     p.add_argument("--dp", type=int, default=None,
                    help="data-parallel ways (inter axis); rest is pipeline")
     args = p.parse_args(argv)
@@ -126,15 +131,34 @@ def main(argv=None):
     stage = Blocks(args.d_model, args.n_heads, args.d_ff, args.layers_per_stage)
     head = nn.Dense(args.n_classes)
 
+    v = args.virtual_stages
+    if v < 1:
+        raise SystemExit("--virtual-stages must be >= 1")
+    if v > 1 and args.schedule != "1f1b":
+        raise SystemExit("--virtual-stages > 1 requires --schedule 1f1b")
+
     x0 = jnp.zeros((2, *shape))
     embed_params = patchify.init(jax.random.PRNGKey(0), x0)
     tok0 = patchify.apply(embed_params, x0)
-    # One stage per pipeline rank, stacked on a leading axis sharded over
-    # 'intra' — each device holds only its own stage's weights.
-    stage_params = jax.tree.map(
-        lambda *xs: jnp.stack(xs),
-        *[stage.init(jax.random.PRNGKey(10 + i), tok0) for i in range(pp)],
-    )
+    if v == 1:
+        # One stage per pipeline rank, stacked on a leading axis sharded
+        # over 'intra' — each device holds only its own stage's weights.
+        stage_params = jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *[stage.init(jax.random.PRNGKey(10 + i), tok0) for i in range(pp)],
+        )
+    else:
+        # Interleaved assignment: device d's chunk l is GLOBAL stage
+        # l*pp + d; stacked (pp, v, ...), still sharded over 'intra'.
+        inits = [
+            stage.init(jax.random.PRNGKey(10 + i), tok0)
+            for i in range(pp * v)
+        ]
+        stage_params = jax.tree.map(
+            lambda *xs: jnp.stack(xs).reshape(v, pp, *xs[0].shape)
+            .swapaxes(0, 1),
+            *inits,
+        )
     head_params = head.init(jax.random.PRNGKey(1), tok0.mean(axis=1))
 
     opt = optax.adamw(args.lr, weight_decay=0.01)
@@ -179,11 +203,22 @@ def main(argv=None):
             lambda ep: patchify.apply(ep, x), params["embed"]
         )
         mine = jax.tree.map(lambda p: jnp.squeeze(p, 0), params["stages"])
-        loss, sg, hg, gtok = pipeline_1f1b_loss_and_grads(
-            stage.apply, head_loss, mine, tokens, y, "intra",
-            args.microbatches, loss_params=params["head"],
-            with_input_grads=True,
-        )
+        if v > 1:
+            from chainermn_tpu.parallel.pipeline import (
+                pipeline_interleaved_1f1b_loss_and_grads,
+            )
+
+            loss, sg, hg, gtok = pipeline_interleaved_1f1b_loss_and_grads(
+                stage.apply, head_loss, mine, tokens, y, "intra",
+                args.microbatches, v, loss_params=params["head"],
+                with_input_grads=True,
+            )
+        else:
+            loss, sg, hg, gtok = pipeline_1f1b_loss_and_grads(
+                stage.apply, head_loss, mine, tokens, y, "intra",
+                args.microbatches, loss_params=params["head"],
+                with_input_grads=True,
+            )
         gtok = jax.lax.psum(gtok, "intra")   # stage-0 owner
         hg = jax.lax.psum(hg, "intra")       # last-stage owner
         (eg,) = embed_vjp(gtok)

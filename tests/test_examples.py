@@ -126,3 +126,16 @@ def test_long_context_packed_smoke():
         "--layers", "1", "--vocab", "64", "--epochs", "1",
         "--steps-per-epoch", "4", "--dtype", "float32",
     )
+
+
+@pytest.mark.slow
+def test_vit_interleaved_1f1b_smoke():
+    """Interleaved virtual-stage 1F1B: pp=4 devices x v=2 chunks, dp=2."""
+    _run(
+        "vit/train_vit.py",
+        "--epochs", "1", "--batchsize", "8", "--image-size", "32",
+        "--patch", "8", "--d-model", "32", "--n-heads", "2",
+        "--d-ff", "64", "--layers-per-stage", "1", "--n-classes", "10",
+        "--microbatches", "4", "--train-size", "16", "--schedule", "1f1b",
+        "--virtual-stages", "2", "--dp", "2",
+    )

@@ -210,3 +210,175 @@ def test_pipeline_1f1b_with_head_and_input_grads(pp_mesh):
         np.testing.assert_allclose(
             np.asarray(gd), np.asarray(gr), rtol=1e-4, atol=1e-5
         )
+
+
+# ---------------------------------------------------------------------------
+# Interleaved (virtual-stage) 1F1B
+# ---------------------------------------------------------------------------
+
+
+def make_chunked_params(n_chunks, seed=0):
+    """(n_stages, n_chunks, ...) stacked params: device d's chunk l is
+    GLOBAL stage l*n + d (the interleaved assignment)."""
+    L = N_STAGES * n_chunks
+    ks = jax.random.split(jax.random.PRNGKey(seed), L)
+    full = {
+        "w": jnp.stack([jax.random.normal(k, (D, D)) * 0.5 for k in ks]),
+        "b": jnp.stack([jnp.zeros((D,)) for _ in ks]),
+    }
+    # stage s = l*n + d  →  [d][l] = full[s]
+    per_dev = jax.tree.map(
+        lambda p: jnp.stack([
+            jnp.stack([p[l * N_STAGES + d] for l in range(n_chunks)])
+            for d in range(N_STAGES)
+        ]),
+        full,
+    )
+    return full, per_dev
+
+
+def sequential_oracle_L(full, x, L):
+    for s in range(L):
+        x = stage_fn(jax.tree.map(lambda p: p[s], full), x)
+    return x
+
+
+@pytest.mark.parametrize("n_chunks,n_micro", [(2, 4), (2, 8), (3, 4)])
+def test_interleaved_1f1b_matches_oracle(pp_mesh, n_chunks, n_micro):
+    """v chunks per device: loss and per-chunk grads must match jax.grad
+    of the L = n*v stage sequential oracle exactly."""
+    from chainermn_tpu.parallel.pipeline import (
+        pipeline_interleaved_1f1b_loss_and_grads,
+    )
+
+    L = N_STAGES * n_chunks
+    full, per_dev = make_chunked_params(n_chunks)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, D))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (8, D))
+
+    def loss_on_out(out, target):
+        return jnp.mean((out - target) ** 2)
+
+    def body(per_dev, x, tgt):
+        mine = jax.tree.map(lambda p: jnp.squeeze(p, 0), per_dev)
+        loss, g = pipeline_interleaved_1f1b_loss_and_grads(
+            stage_fn, loss_on_out, mine, x, tgt, "intra", n_micro,
+            n_chunks,
+        )
+        return loss, jax.tree.map(lambda a: jnp.expand_dims(a, 0), g)
+
+    f = jax.jit(
+        shard_map(
+            body, mesh=pp_mesh,
+            in_specs=(P("intra"), P(), P()),
+            out_specs=(P(), P("intra")),
+            check_vma=False,
+        )
+    )
+    loss, grads = f(per_dev, x, tgt)
+
+    def ref_loss(full):
+        return loss_on_out(sequential_oracle_L(full, x, L), tgt)
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(full)
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+    # Re-interleave the oracle grads into the (n, v, ...) layout.
+    ref_per_dev = jax.tree.map(
+        lambda p: jnp.stack([
+            jnp.stack([p[l * N_STAGES + d] for l in range(n_chunks)])
+            for d in range(N_STAGES)
+        ]),
+        ref_g,
+    )
+    for gd, gr in zip(jax.tree.leaves(grads), jax.tree.leaves(ref_per_dev)):
+        np.testing.assert_allclose(
+            np.asarray(gd), np.asarray(gr), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_interleaved_1f1b_head_and_input_grads(pp_mesh):
+    """Composed form with v=2: head inside the schedule, input cotangents
+    out; all grads match end-to-end jax.grad."""
+    from chainermn_tpu.parallel.pipeline import (
+        pipeline_interleaved_1f1b_loss_and_grads,
+    )
+
+    n_chunks = 2
+    L = N_STAGES * n_chunks
+    full, per_dev = make_chunked_params(n_chunks)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, D))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (8, D))
+    embed_w = jax.random.normal(jax.random.PRNGKey(3), (D, D)) * 0.5
+    head_w = jax.random.normal(jax.random.PRNGKey(4), (D, D)) * 0.5
+
+    def head_loss(hw, out, target):
+        return jnp.mean((out @ hw - target) ** 2)
+
+    def body(per_dev, embed_w, head_w, x, tgt):
+        mine = jax.tree.map(lambda p: jnp.squeeze(p, 0), per_dev)
+        tokens, embed_vjp = jax.vjp(lambda w: jnp.tanh(x @ w), embed_w)
+        loss, sg, hg, gtok = pipeline_interleaved_1f1b_loss_and_grads(
+            stage_fn, head_loss, mine, tokens, tgt, "intra", 4, n_chunks,
+            loss_params=head_w, with_input_grads=True,
+        )
+        gtok = jax.lax.psum(gtok, "intra")
+        hg = jax.lax.psum(hg, "intra")
+        (eg,) = embed_vjp(gtok)
+        return loss, jax.tree.map(lambda a: jnp.expand_dims(a, 0), sg), eg, hg
+
+    f = jax.jit(
+        shard_map(
+            body, mesh=pp_mesh,
+            in_specs=(P("intra"), P(), P(), P(), P()),
+            out_specs=(P(), P("intra"), P(), P()),
+            check_vma=False,
+        )
+    )
+    loss, sg, eg, hg = f(per_dev, embed_w, head_w, x, tgt)
+
+    def ref_loss(full, embed_w, head_w):
+        out = sequential_oracle_L(full, jnp.tanh(x @ embed_w), L)
+        return head_loss(head_w, out, tgt)
+
+    ref_l, (ref_sg, ref_eg, ref_hg) = jax.value_and_grad(
+        ref_loss, argnums=(0, 1, 2)
+    )(full, embed_w, head_w)
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(eg), np.asarray(ref_eg), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hg), np.asarray(ref_hg), rtol=1e-4, atol=1e-5)
+    ref_per_dev = jax.tree.map(
+        lambda p: jnp.stack([
+            jnp.stack([p[l * N_STAGES + d] for l in range(n_chunks)])
+            for d in range(N_STAGES)
+        ]),
+        ref_sg,
+    )
+    for gd, gr in zip(jax.tree.leaves(sg), jax.tree.leaves(ref_per_dev)):
+        np.testing.assert_allclose(
+            np.asarray(gd), np.asarray(gr), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_interleaved_rejects_bad_round(pp_mesh):
+    from chainermn_tpu.parallel.pipeline import (
+        pipeline_interleaved_1f1b_loss_and_grads,
+    )
+
+    _full, per_dev = make_chunked_params(2)
+    x = jnp.ones((6, D))
+    tgt = jnp.ones((6, D))
+
+    def body(per_dev, x, tgt):
+        mine = jax.tree.map(lambda p: jnp.squeeze(p, 0), per_dev)
+        loss, _ = pipeline_interleaved_1f1b_loss_and_grads(
+            stage_fn, lambda o, t: jnp.mean((o - t) ** 2), mine, x, tgt,
+            "intra", 6, 2,
+        )
+        return loss
+
+    f = shard_map(
+        body, mesh=pp_mesh, in_specs=(P("intra"), P(), P()), out_specs=P(),
+        check_vma=False,
+    )
+    with pytest.raises(ValueError, match="rounds"):
+        jax.jit(f)(per_dev, x, tgt)
