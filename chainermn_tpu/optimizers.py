@@ -128,15 +128,20 @@ class MultiNodeOptimizer:
         self._params = None
         self._state = None
         self._step_fn = None
+        self._setup_has_aux = False
 
     # ------------------------------------------------------------------
     # Functional API
     # ------------------------------------------------------------------
-    def init(self, params) -> MultiNodeOptimizerState:
+    def init(self, params, *, _skip_broadcast: bool = False
+             ) -> MultiNodeOptimizerState:
         """Initialize optimizer state.  The analogue of the reference's
         first-``update`` ``broadcast_data``: parameters are replicated from
-        process 0 so every host starts identical."""
-        params = self.broadcast_params(params)
+        process 0 so every host starts identical.  (``_skip_broadcast``:
+        internal — setup() broadcasts once itself and must not pay the
+        full-tree collective twice.)"""
+        if not _skip_broadcast:
+            params = self.broadcast_params(params)
         if self.zero_stage == 3:
             self._capture_z3_meta(params)
         if self.zero_stage > 0:
@@ -880,33 +885,51 @@ class MultiNodeOptimizer:
     # ------------------------------------------------------------------
     # Imperative parity API (reference: optimizer.setup(model) + update())
     # ------------------------------------------------------------------
-    def setup(self, params, loss_fn: Callable, batch_spec=None):
-        if self.zero_stage == 3:
-            raise NotImplementedError(
-                "the imperative setup()/update() surface does not support "
-                "zero_stage=3 (the step trades in a flat sharded buffer); "
-                "use init/shard_params/make_train_step/materialize directly"
-            )
-        self._params = self.broadcast_params(params)
-        self._state = self.init(self._params)
-        self._step_fn = self.make_train_step(
-            loss_fn, batch_spec=batch_spec, donate=False
+    def setup(self, params, loss_fn: Callable, batch_spec=None, *,
+              rng: Any = None, n_accum: int = 1, has_aux: bool = False,
+              loss_scale: float | None = None):
+        """Imperative surface with the FULL feature matrix of
+        :meth:`make_train_step` — ``rng`` (per-(step, device) dropout
+        keys), ``n_accum`` (gradient accumulation), ``has_aux`` (update()
+        returns ``(loss, aux)``), ``loss_scale``, and every
+        ``zero_stage`` incl. 3 (parameters live as the flat sharded
+        master buffer internally; :attr:`target` materializes them)."""
+        # Exactly ONE full-tree broadcast: init() is told to skip its
+        # own (the reference's first-update broadcast_data contract is
+        # still honored — by this call).
+        params = self.broadcast_params(params)
+        self._state = self.init(params, _skip_broadcast=True)
+        self._params = (
+            self.shard_params(params) if self.zero_stage == 3 else params
         )
+        self._step_fn = self.make_train_step(
+            loss_fn, batch_spec=batch_spec, donate=False,
+            rng=rng, n_accum=n_accum, has_aux=has_aux,
+            loss_scale=loss_scale,
+        )
+        self._setup_has_aux = has_aux
         return self
 
     def update(self, batch):
         """Imperative one-step update, mirroring the reference's
-        ``optimizer.update(loss_func, *args)`` call shape."""
+        ``optimizer.update(loss_func, *args)`` call shape.  Returns the
+        loss, or ``(loss, aux)`` when setup() was given ``has_aux``."""
         if self._step_fn is None:
             raise RuntimeError("call setup(params, loss_fn) before update()")
-        self._params, self._state, loss = self._step_fn(
-            self._params, self._state, batch
-        )
+        out = self._step_fn(self._params, self._state, batch)
+        if self._setup_has_aux:
+            self._params, self._state, loss, aux = out
+            return loss, aux
+        self._params, self._state, loss = out
         return loss
 
     @property
     def target(self):
-        """Current parameters (reference: ``optimizer.target`` is the model)."""
+        """Current parameters (reference: ``optimizer.target`` is the
+        model).  Under ``zero_stage=3`` the sharded master buffer is
+        materialized back to the parameter tree."""
+        if self.zero_stage == 3 and self._params is not None:
+            return self.materialize(self._params)
         return self._params
 
     @property

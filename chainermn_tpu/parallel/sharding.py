@@ -31,7 +31,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 def transformer_param_spec(params, model_axis: str = "model"):
     """PartitionSpec pytree for the transformer/ViT families in
     ``chainermn_tpu.models``: attention heads and MLP hidden sharded over
-    ``model_axis``, everything else replicated."""
+    ``model_axis``, everything else replicated.
+
+    The rules are NAME-PATTERN matches (``query``/``key``/``value``/
+    ``out``/``wi``/``wo`` path substrings — the naming of this package's
+    models).  A model with different parameter naming would silently
+    replicate everything, so a spec that shards NOTHING raises — pass a
+    hand-written spec tree to :func:`make_gspmd_train_step` for custom
+    naming instead."""
 
     def spec_for(path, leaf) -> P:
         names = [
@@ -51,7 +58,21 @@ def transformer_param_spec(params, model_axis: str = "model"):
             return P(model_axis, None)
         return P()
 
-    return jax.tree_util.tree_map_with_path(spec_for, params)
+    spec = jax.tree_util.tree_map_with_path(spec_for, params)
+    if not any(
+        any(ax is not None for ax in s) for s in jax.tree.leaves(
+            spec, is_leaf=lambda x: isinstance(x, P)
+        )
+    ):
+        raise ValueError(
+            "transformer_param_spec matched NO shardable parameters — "
+            "tensor parallelism would silently do nothing.  The rules "
+            "key on this package's layer names (query/key/value/out, "
+            "wi/wo); for a model with different naming, write the "
+            "PartitionSpec tree by hand and pass it to "
+            "make_gspmd_train_step directly."
+        )
+    return spec
 
 
 def make_gspmd_train_step(

@@ -118,3 +118,14 @@ def test_gspmd_shards_optimizer_state(dp_tp_mesh):
     flat_mu = jax.tree_util.tree_flatten_with_path(mu)[0]
     q_mu = [l for path, l in flat_mu if "query" in str(path)][0]
     assert q_mu.sharding == q.sharding
+
+
+def test_param_spec_rejects_unmatched_naming():
+    """A model whose parameter names match none of the TP rules must
+    raise, not silently replicate everything (TP doing nothing)."""
+    foreign = {
+        "dense_a": {"weight": jnp.zeros((8, 8))},
+        "dense_b": {"weight": jnp.zeros((8, 8))},
+    }
+    with pytest.raises(ValueError, match="matched NO shardable"):
+        transformer_param_spec(foreign)
