@@ -475,6 +475,14 @@ def _flash_lse_vjp_bwd(scale, causal, block_q, block_k, interpret, res, cots):
 flash_attention_with_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 
+def segment_mask(q_segment_ids, kv_segment_ids):
+    """(B, Sq) × (B, Sk) int ids → (B, Sq, Sk) boolean equality mask —
+    THE packed-sequence mask rule, shared by the XLA fallback, ring, and
+    zigzag paths (one definition to evolve, e.g. a future 'padding id
+    matches nothing' convention)."""
+    return q_segment_ids[:, :, None] == kv_segment_ids[:, None, :]
+
+
 def _xla_attention(q, k, v, scale, causal, q_segment_ids=None,
                    kv_segment_ids=None):
     logits = jnp.einsum(
@@ -485,7 +493,7 @@ def _xla_attention(q, k, v, scale, causal, q_segment_ids=None,
     if causal:
         mask = jnp.tril(jnp.ones((Sq, Sk), bool))[None]
     if q_segment_ids is not None:
-        seg = q_segment_ids[:, :, None] == kv_segment_ids[:, None, :]
+        seg = segment_mask(q_segment_ids, kv_segment_ids)
         mask = seg if mask is None else (mask & seg)
     if mask is not None:
         logits = jnp.where(mask[:, None], logits, _NEG_INF)

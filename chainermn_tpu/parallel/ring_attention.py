@@ -83,11 +83,17 @@ def ring_attention(
     axis_name: str,
     causal: bool = True,
     scale: Optional[float] = None,
+    q_segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
 ):
     """Sequence-parallel attention; call inside ``shard_map`` with the
     sequence dimension sharded over ``axis_name``.
 
     q, k, v: (B, S_local, H, D) — this chip's sequence shard.
+    ``q_segment_ids``/``kv_segment_ids``: optional (B, S_local) int32
+    LOCAL shards of packed-sequence segment ids — the KV ids rotate
+    around the ring with their K/V blocks, so attention never crosses a
+    segment boundary even when the boundary crosses a shard boundary.
     Returns (B, S_local, H, D) attention output for the local queries,
     numerically identical (up to fp32 accumulation order) to full
     attention over the gathered sequence.
@@ -97,34 +103,56 @@ def ring_attention(
     B, S, H, D = q.shape
     if scale is None:
         scale = 1.0 / (D**0.5)
+    if kv_segment_ids is not None and q_segment_ids is None:
+        raise ValueError(
+            "kv_segment_ids without q_segment_ids would be silently "
+            "ignored; pass q_segment_ids (optionally alone — kv defaults "
+            "to it)"
+        )
+    if kv_segment_ids is None:
+        kv_segment_ids = q_segment_ids
 
     q_pos = my * S + jnp.arange(S)  # global positions of local queries
 
     perm = [(i, (i + 1) % n) for i in range(n)]
+    segmented = q_segment_ids is not None
+    seg0 = (
+        kv_segment_ids.astype(jnp.int32) if segmented
+        else jnp.zeros((B, S), jnp.int32)  # carried but unused
+    )
 
     def body(carry, j):
-        k_blk, v_blk, acc, m_run, l_run = carry
+        k_blk, v_blk, seg_blk, acc, m_run, l_run = carry
         src = (my - j) % n                   # originating rank of this block
         k_pos = src * S + jnp.arange(S)
         if causal:
             mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
         else:
             mask = None
+        if segmented:
+            from chainermn_tpu.ops.flash_attention import segment_mask
+
+            seg_mask = segment_mask(q_segment_ids, seg_blk)[:, None]
+            mask = seg_mask if mask is None else (mask & seg_mask)
         blk = _block_attn(q, k_blk, v_blk, mask, scale)
         m_new, l_new, acc_new = _online_merge((m_run, l_run, acc), blk)
 
-        # Rotate K/V to the next chip (skipped after the last block's use
-        # would be wasted, but a uniform scan keeps the program static).
+        # Rotate K/V (and their segment ids) to the next chip (skipped
+        # after the last block's use would be wasted, but a uniform scan
+        # keeps the program static).
         k_nxt = lax.ppermute(k_blk, axis_name, perm)
         v_nxt = lax.ppermute(v_blk, axis_name, perm)
-        return (k_nxt, v_nxt, acc_new, m_new, l_new), None
+        seg_nxt = (
+            lax.ppermute(seg_blk, axis_name, perm) if segmented else seg_blk
+        )
+        return (k_nxt, v_nxt, seg_nxt, acc_new, m_new, l_new), None
 
     acc0 = jnp.zeros((B, S, H, D), jnp.float32)
     m0 = jnp.full((B, H, S), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((B, H, S), jnp.float32)
 
-    (_, _, acc, _, l), _ = lax.scan(
-        jax.checkpoint(body), (k, v, acc0, m0, l0), jnp.arange(n)
+    (_, _, _, acc, _, l), _ = lax.scan(
+        jax.checkpoint(body), (k, v, seg0, acc0, m0, l0), jnp.arange(n)
     )
 
     denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
@@ -192,6 +220,7 @@ def zigzag_ring_attention(
     axis_name: str,
     scale: Optional[float] = None,
     use_flash: Optional[bool] = None,
+    segment_ids: Optional[jax.Array] = None,
 ):
     """Causal ring attention over zigzag-sharded sequences — half the FLOPs
     of :func:`ring_attention` at perfect load balance.
@@ -207,6 +236,12 @@ def zigzag_ring_attention(
       behind it, OTHERWISE its late chunk attends the received late chunk
       — exactly one of the two is causally live, selected by data, so the
       program stays uniform while no chip computes a dead block.
+
+    ``segment_ids``: optional (B, S_local) int32 packed-sequence ids IN
+    ZIGZAG LAYOUT (apply the same :func:`zigzag_indices` permutation as
+    the activations); they rotate with the K/V blocks.  Supported on the
+    dense inner path only — combined with ``use_flash=True`` this raises
+    (the flash-with-LSE composition kernel has no segment masks).
     """
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
@@ -226,8 +261,15 @@ def zigzag_ring_attention(
 
     interpret = jax.default_backend() not in ("tpu", "axon")
     flash_ok, flash_blk = flash_block_plan(C, q.shape[-1], q.dtype, interpret)
+    segmented = segment_ids is not None
+    if segmented and use_flash:
+        raise ValueError(
+            "segment_ids are supported on the dense inner path only; "
+            "pass use_flash=False (or None)"
+        )
     if use_flash is None:
-        use_flash = flash_ok and not interpret   # off-TPU interpret is slow
+        # off-TPU interpret is slow; segments force the dense path.
+        use_flash = flash_ok and not interpret and not segmented
     elif use_flash and not flash_ok:
         raise ValueError(
             f"use_flash=True but the kernel block plan refused chunk shape "
@@ -238,12 +280,18 @@ def zigzag_ring_attention(
             f"pass use_flash=False (or None) to use the XLA path"
         )
 
-    def block_stats(qc, kc, vc, causal):
+    def block_stats(qc, kc, vc, causal, qseg=None, kseg=None):
         if use_flash:
             return _flash_block_stats(
                 qc, kc, vc, causal, scale, flash_blk, interpret
             )
-        return _block_attn(qc, kc, vc, tri if causal else None, scale)
+        mask = tri if causal else None
+        if qseg is not None:
+            from chainermn_tpu.ops.flash_attention import segment_mask
+
+            sm = segment_mask(qseg, kseg)[:, None]
+            mask = sm if mask is None else (mask & sm)
+        return _block_attn(qc, kc, vc, mask, scale)
 
     def zeros_stats():
         return (
@@ -252,34 +300,57 @@ def zigzag_ring_attention(
             jnp.zeros((B, C, H, D), jnp.float32),
         )
 
+    seg = (
+        segment_ids.astype(jnp.int32) if segmented
+        else jnp.zeros((B, S), jnp.int32)  # carried but unused
+    )
+    sega, segb = seg[:, :C], seg[:, C:]
+
+    def segargs(qseg, kseg):
+        return (qseg, kseg) if segmented else (None, None)
+
     # j = 0: own block — both diagonals triangular, late-attends-early full.
-    sa = _online_merge(zeros_stats(), block_stats(qa, k[:, :C], v[:, :C], True))
-    sb = _online_merge(zeros_stats(), block_stats(qb, k[:, :C], v[:, :C], False))
-    sb = _online_merge(sb, block_stats(qb, k[:, C:], v[:, C:], True))
+    sa = _online_merge(zeros_stats(), block_stats(
+        qa, k[:, :C], v[:, :C], True, *segargs(sega, sega)
+    ))
+    sb = _online_merge(zeros_stats(), block_stats(
+        qb, k[:, :C], v[:, :C], False, *segargs(segb, sega)
+    ))
+    sb = _online_merge(sb, block_stats(
+        qb, k[:, C:], v[:, C:], True, *segargs(segb, segb)
+    ))
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def body(carry, j):
-        k_blk, v_blk, sa, sb = carry
+        k_blk, v_blk, seg_blk, sa, sb = carry
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
+        seg_blk = (
+            lax.ppermute(seg_blk, axis_name, perm) if segmented else seg_blk
+        )
         # After j rotations the block originates at rank (my - j) mod n.
         early_live = my >= j           # src strictly behind: a·ka live
         # One conditional half-block: a·ka when early_live, else b·kb.
         q_in = jnp.where(early_live, qa, qb)
         k_in = jnp.where(early_live, k_blk[:, :C], k_blk[:, C:])
         v_in = jnp.where(early_live, v_blk[:, :C], v_blk[:, C:])
-        blk2 = block_stats(q_in, k_in, v_in, False)
+        qseg_in = jnp.where(early_live, sega, segb)
+        kseg_in = jnp.where(early_live, seg_blk[:, :C], seg_blk[:, C:])
+        blk2 = block_stats(
+            q_in, k_in, v_in, False, *segargs(qseg_in, kseg_in)
+        )
         sa = _online_merge(sa, blk2, gate=early_live)
         sb = _online_merge(sb, blk2, gate=jnp.logical_not(early_live))
         # Late chunk b always attends the received early chunk ka.
-        sb = _online_merge(
-            sb, block_stats(qb, k_blk[:, :C], v_blk[:, :C], False)
-        )
-        return (k_blk, v_blk, sa, sb), None
+        sb = _online_merge(sb, block_stats(
+            qb, k_blk[:, :C], v_blk[:, :C], False,
+            *segargs(segb, seg_blk[:, :C])
+        ))
+        return (k_blk, v_blk, seg_blk, sa, sb), None
 
-    (_, _, sa, sb), _ = lax.scan(
-        jax.checkpoint(body), (k, v, sa, sb), jnp.arange(1, n)
+    (_, _, _, sa, sb), _ = lax.scan(
+        jax.checkpoint(body), (k, v, seg, sa, sb), jnp.arange(1, n)
     )
 
     def finish(stats):
@@ -290,23 +361,60 @@ def zigzag_ring_attention(
     return jnp.concatenate([finish(sa), finish(sb)], axis=1)
 
 
-def make_ring_attention_fn(axis_name: str, causal: bool = True):
+def _local_seg_slice(segment_ids, axis_name, s_local, batch):
+    """Slice row-uniform GLOBAL (S,) segment ids to this chip's local
+    shard inside shard_map (ids bound at construction cannot know the
+    shard; ``lax.axis_index`` can)."""
+    if segment_ids.ndim != 1:
+        raise ValueError(
+            f"adapter segment_ids must be row-uniform GLOBAL (S,), got "
+            f"shape {segment_ids.shape} — per-row (B, S) ids go to "
+            "ring_attention/ulysses_attention directly (as LOCAL shards)"
+        )
+    my = lax.axis_index(axis_name)
+    row = lax.dynamic_slice_in_dim(
+        segment_ids.astype(jnp.int32), my * s_local, s_local
+    )
+    return jnp.broadcast_to(row[None], (batch, s_local))
+
+
+def make_ring_attention_fn(axis_name: str, causal: bool = True,
+                           segment_ids=None):
     """Adapter with the ``attention_fn(q, k, v, mask)`` signature the
-    transformer layers accept (mask ignored: causality is positional)."""
+    transformer layers accept (mask ignored: causality is positional).
+    ``segment_ids``: optional row-uniform GLOBAL (S,) packed-sequence
+    ids, sliced per shard at call time via the traced axis index."""
 
     def fn(q, k, v, mask=None):
         del mask
-        return ring_attention(q, k, v, axis_name, causal=causal)
+        qs = ks = None
+        if segment_ids is not None:
+            qs = _local_seg_slice(
+                segment_ids, axis_name, q.shape[1], q.shape[0]
+            )
+            ks = qs
+        return ring_attention(
+            q, k, v, axis_name, causal=causal,
+            q_segment_ids=qs, kv_segment_ids=ks,
+        )
 
     return fn
 
 
-def make_zigzag_ring_attention_fn(axis_name: str):
+def make_zigzag_ring_attention_fn(axis_name: str, segment_ids=None):
     """Adapter for :func:`zigzag_ring_attention` (always causal; inputs
-    must be in zigzag shard layout, see :func:`zigzag_indices`)."""
+    must be in zigzag shard layout, see :func:`zigzag_indices`).
+    ``segment_ids``: optional row-uniform GLOBAL (S,) ids ALREADY in
+    zigzag layout (apply the same permutation as the tokens); dense inner
+    path only."""
 
     def fn(q, k, v, mask=None):
         del mask
-        return zigzag_ring_attention(q, k, v, axis_name)
+        seg = None
+        if segment_ids is not None:
+            seg = _local_seg_slice(
+                segment_ids, axis_name, q.shape[1], q.shape[0]
+            )
+        return zigzag_ring_attention(q, k, v, axis_name, segment_ids=seg)
 
     return fn

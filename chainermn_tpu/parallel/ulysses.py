@@ -29,12 +29,20 @@ def ulysses_attention(
     axis_name: str,
     causal: bool = True,
     scale: Optional[float] = None,
+    q_segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
 ):
     """Sequence-parallel attention via head↔sequence all-to-all.
 
     q, k, v: (B, S_local, H, D) sequence-sharded inputs (inside
     ``shard_map`` over ``axis_name``); returns (B, S_local, H, D).
     Requires the head count H to be divisible by the axis size.
+    ``q_segment_ids``/``kv_segment_ids``: optional (B, S_local) int32
+    LOCAL shards of packed-sequence segment ids — all-gathered alongside
+    the head reshard (attention here runs over the FULL sequence per
+    chip) — or already-full (B, S_local * n) ids, used as-is (the
+    adapter's closure-constant path, no collective).  Passed to the
+    shared flash kernel's segment masks.
     """
     n = lax.axis_size(axis_name)
     B, S_loc, H, D = q.shape
@@ -42,6 +50,14 @@ def ulysses_attention(
         raise ValueError(f"head count {H} not divisible by axis size {n}")
     if scale is None:
         scale = 1.0 / (D**0.5)
+    if kv_segment_ids is not None and q_segment_ids is None:
+        raise ValueError(
+            "kv_segment_ids without q_segment_ids would be silently "
+            "ignored; pass q_segment_ids (optionally alone — kv defaults "
+            "to it)"
+        )
+    if kv_segment_ids is None:
+        kv_segment_ids = q_segment_ids
 
     # (B, S_loc, H, D) → (B, S_full, H/n, D): split heads, concat sequence.
     def to_heads(x):
@@ -52,20 +68,57 @@ def ulysses_attention(
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
 
+    qs = ks = None
+    if q_segment_ids is not None:
+        def full_ids(ids):
+            ids = ids.astype(jnp.int32)
+            if ids.shape[1] == S_loc * n:
+                return ids  # already full-sequence: no collective needed
+            if ids.shape[1] != S_loc:
+                raise ValueError(
+                    f"segment ids sequence length {ids.shape[1]} is "
+                    f"neither local ({S_loc}) nor full ({S_loc * n})"
+                )
+            return lax.all_gather(ids, axis_name, axis=1, tiled=True)
+
+        qs = full_ids(q_segment_ids)
+        ks = full_ids(kv_segment_ids)
+
     # Local compute on the full sequence / head shard: the hot attention op
     # shared with ops.flash_attention (Pallas kernel where shapes allow,
     # XLA fallback otherwise — one implementation of the math to maintain).
     from chainermn_tpu.ops.flash_attention import flash_attention
 
-    out = flash_attention(qh, kh, vh, causal=causal, scale=scale)
+    out = flash_attention(
+        qh, kh, vh, causal=causal, scale=scale,
+        q_segment_ids=qs, kv_segment_ids=ks,
+    )
     return to_seq(out.astype(q.dtype))
 
 
-def make_ulysses_attention_fn(axis_name: str, causal: bool = True):
-    """Adapter matching the transformer layers' ``attention_fn`` slot."""
+def make_ulysses_attention_fn(axis_name: str, causal: bool = True,
+                              segment_ids=None):
+    """Adapter matching the transformer layers' ``attention_fn`` slot.
+    ``segment_ids``: optional row-uniform GLOBAL (S,) packed-sequence
+    ids, sliced per shard at call time via the traced axis index."""
 
     def fn(q, k, v, mask=None):
         del mask
-        return ulysses_attention(q, k, v, axis_name, causal=causal)
+        qs = None
+        if segment_ids is not None:
+            if segment_ids.ndim != 1:
+                raise ValueError(
+                    "adapter segment_ids must be row-uniform GLOBAL (S,)"
+                )
+            # The closure already holds the FULL row: broadcast it
+            # directly — attention runs over the full sequence here, so
+            # no slice-then-all_gather round trip is needed.
+            qs = jnp.broadcast_to(
+                segment_ids.astype(jnp.int32)[None],
+                (q.shape[0], segment_ids.shape[0]),
+            )
+        return ulysses_attention(
+            q, k, v, axis_name, causal=causal, q_segment_ids=qs,
+        )
 
     return fn

@@ -78,8 +78,9 @@ def main(argv=None):
     p.add_argument("--checkpoint-name", default="long_context")
     p.add_argument("--packed", action="store_true",
                    help="packed-sequence training: two documents per row, "
-                   "flash attention masked by segment ids so tokens never "
-                   "attend across document boundaries (sp=none + flash)")
+                   "segment ids keep attention inside document boundaries "
+                   "through EVERY backend (flash kernel masks, rotating "
+                   "ring/zigzag KV ids, ulysses all-gathered ids)")
     args = p.parse_args(argv)
 
     comm = chainermn_tpu.create_communicator("xla_ici", inter_size=args.dp)
@@ -87,39 +88,49 @@ def main(argv=None):
     S, B, vocab = args.seq_len, args.batchsize, args.vocab
     dtype = jnp.dtype(args.dtype)
 
-    if args.packed and (args.sp != "none" or args.no_flash):
+    if args.packed and args.sp == "none" and args.no_flash:
         raise SystemExit(
-            "--packed needs the flash kernel's segment masks: use "
-            "--sp none without --no-flash (segment threading through "
-            "ring/zigzag/ulysses is not implemented)"
+            "--packed with --sp none needs the flash kernel's segment "
+            "masks: drop --no-flash"
         )
+
+    # Packed-sequence training: two documents per row at the S/2
+    # boundary.  Row-uniform (S,) segment ids (every row shares the
+    # boundary) thread through EVERY attention backend — the flash
+    # kernel's segment masks (sp=none), rotating KV ids (ring/zigzag),
+    # or the all-gathered ids around the local kernel (ulysses).
+    seg_row = (
+        jnp.asarray((np.arange(S) >= S // 2).astype(np.int32))
+        if args.packed else None
+    )
 
     if args.sp == "none":
         if args.packed:
-            # Two documents packed per row at the S/2 boundary: segment
-            # ids gate the flash kernel so attention never crosses the
-            # boundary, and positions restart per document.
-            # Row-uniform (S,) ids: the DP-safe adapter form (every row
-            # shares the S/2 boundary, so shards need no row identity).
-            seg_row = jnp.asarray(
-                (np.arange(S) >= S // 2).astype(np.int32)
-            )
             attention_fn = make_flash_attention_fn(q_segment_ids=seg_row)
         else:
             attention_fn = None if args.no_flash else make_flash_attention_fn()
         sp_ways_eff = 1
     elif args.sp == "ring":
-        attention_fn = make_ring_attention_fn("intra")
+        attention_fn = make_ring_attention_fn("intra", segment_ids=seg_row)
         sp_ways_eff = sp_ways
     elif args.sp == "zigzag":
         from chainermn_tpu.parallel.ring_attention import (
             make_zigzag_ring_attention_fn,
+            zigzag_indices as _zz,
         )
 
-        attention_fn = make_zigzag_ring_attention_fn("intra")
+        zz_seg = (
+            seg_row[np.asarray(_zz(S, sp_ways))]
+            if args.packed else None
+        )
+        attention_fn = make_zigzag_ring_attention_fn(
+            "intra", segment_ids=zz_seg
+        )
         sp_ways_eff = sp_ways
     else:
-        attention_fn = make_ulysses_attention_fn("intra")
+        attention_fn = make_ulysses_attention_fn(
+            "intra", segment_ids=seg_row
+        )
         sp_ways_eff = sp_ways
     if args.sp != "none" and sp_ways == 1:
         raise SystemExit(
@@ -165,13 +176,13 @@ def main(argv=None):
 
     # Predicted positions: each packed document loses its final token.
     denom = B * (S - 2) if args.packed else B * (S - 1)
-    packed_pos = (
-        jnp.asarray(
-            np.concatenate([np.arange(S // 2)] * 2).astype(np.int32)
-        )
-        if args.packed
-        else None
+    # THE per-document position rule, shared by every path: positions
+    # restart at the packing boundary (plain global order otherwise).
+    base_pos_np = (
+        np.concatenate([np.arange(S // 2)] * 2).astype(np.int32)
+        if args.packed else np.arange(S, dtype=np.int32)
     )
+    packed_pos = jnp.asarray(base_pos_np) if args.packed else None
 
     if args.sp == "none":
         # Pure DP path through the reference-shaped optimizer wrapper.
@@ -231,7 +242,9 @@ def main(argv=None):
             seq_perm = zigzag_indices(S, sp_ways)
         else:
             seq_perm = np.arange(S)
-        positions = jnp.asarray(seq_perm, jnp.int32)
+        # Positions index the model's positional table: the shared
+        # base_pos_np rule carried through the shard layout permutation.
+        positions = jnp.asarray(base_pos_np[seq_perm], jnp.int32)
 
         def step(carry, batch):
             params, opt_state = carry

@@ -116,15 +116,19 @@ def test_long_context_lm_smoke(sp):
 
 
 @pytest.mark.slow
-def test_long_context_packed_smoke():
-    """Packed-sequence training: segment-masked flash attention, two
-    documents per row, positions restarting at the boundary."""
+@pytest.mark.parametrize("sp", ["none", "ring", "zigzag", "ulysses"])
+def test_long_context_packed_smoke(sp):
+    """Packed-sequence training through EVERY attention backend: segment
+    masks in the flash kernel (none), rotating KV ids (ring/zigzag), and
+    all-gathered ids (ulysses); two documents per row, positions
+    restarting at the boundary."""
+    extra = [] if sp == "none" else ["--dp", "2"]
     _run(
         "long_context/train_lm.py",
-        "--packed", "--seq-len", "256", "--batchsize", "8",
+        "--packed", "--sp", sp, "--seq-len", "256", "--batchsize", "8",
         "--d-model", "32", "--n-heads", "4", "--d-ff", "64",
         "--layers", "1", "--vocab", "64", "--epochs", "1",
-        "--steps-per-epoch", "4", "--dtype", "float32",
+        "--steps-per-epoch", "4", "--dtype", "float32", *extra,
     )
 
 

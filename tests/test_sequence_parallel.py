@@ -299,3 +299,157 @@ def test_zigzag_flash_inner_matches_full(seq_mesh, use_flash):
     )(q, k, v)
     for a, b in zip(g, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# Packed sequences: segment masks threaded through the SP family
+# ---------------------------------------------------------------------------
+
+
+def segmented_full_attention(q, k, v, seg, causal=True):
+    """Dense oracle: segment equality (+ causal) mask; fully-masked rows
+    produce zeros."""
+    B, S, H, D = q.shape
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (D**0.5)
+    mask = seg[:, :, None] == seg[:, None, :]
+    if causal:
+        mask = mask & jnp.tril(jnp.ones((S, S), bool))[None]
+    logits = jnp.where(mask[:, None], logits, -jnp.inf)
+    w = jax.nn.softmax(logits)
+    w = jnp.where(mask.any(-1)[:, None, :, None], w, 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+def _packed_seg(B=2, S=16):
+    """Two documents per row with the boundary INSIDE shard 1 (S=16 over
+    4 shards of 4: boundary at 6), so masks must cross shard boundaries."""
+    seg = np.zeros((B, S), np.int32)
+    seg[:, 6:] = 1
+    return jnp.asarray(seg)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_segments_match_oracle(seq_mesh, causal):
+    q, k, v = make_qkv()
+    seg = _packed_seg()
+
+    def body(q, k, v, seg):
+        return ring_attention(
+            q, k, v, "intra", causal=causal, q_segment_ids=seg,
+        )
+
+    f = jax.jit(
+        shard_map(
+            body, mesh=seq_mesh,
+            in_specs=(P(None, "intra"),) * 4,
+            out_specs=P(None, "intra"),
+            check_vma=False,
+        )
+    )
+    out = f(q, k, v, seg)
+    ref = segmented_full_attention(q, k, v, seg, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_ring_attention_segments_gradients(seq_mesh):
+    q, k, v = make_qkv()
+    seg = _packed_seg()
+
+    def sp_loss(q, k, v):
+        def body(q, k, v, seg):
+            return ring_attention(
+                q, k, v, "intra", causal=True, q_segment_ids=seg,
+            )
+
+        out = shard_map(
+            body, mesh=seq_mesh, in_specs=(P(None, "intra"),) * 4,
+            out_specs=P(None, "intra"), check_vma=False,
+        )(q, k, v, seg)
+        return jnp.sum(jnp.sin(out))
+
+    def ref_loss(q, k, v):
+        return jnp.sum(jnp.sin(segmented_full_attention(q, k, v, seg)))
+
+    g = jax.jit(jax.grad(sp_loss, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_ulysses_segments_match_oracle(seq_mesh):
+    q, k, v = make_qkv()
+    seg = _packed_seg()
+
+    def body(q, k, v, seg):
+        return ulysses_attention(
+            q, k, v, "intra", causal=True, q_segment_ids=seg,
+        )
+
+    f = jax.jit(
+        shard_map(
+            body, mesh=seq_mesh,
+            in_specs=(P(None, "intra"),) * 4,
+            out_specs=P(None, "intra"),
+            check_vma=False,
+        )
+    )
+    out = f(q, k, v, seg)
+    ref = segmented_full_attention(q, k, v, seg, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_zigzag_segments_match_oracle(seq_mesh):
+    from chainermn_tpu.parallel.ring_attention import (
+        zigzag_indices, inverse_zigzag_indices, zigzag_ring_attention,
+    )
+
+    B, S = 2, 16
+    q, k, v = make_qkv(B=B, S=S)
+    seg = _packed_seg(B, S)
+    perm = zigzag_indices(S, 4)
+    inv = inverse_zigzag_indices(S, 4)
+    qz, kz, vz = (t[:, perm] for t in (q, k, v))
+    segz = seg[:, perm]
+
+    def body(q, k, v, seg):
+        return zigzag_ring_attention(
+            q, k, v, "intra", segment_ids=seg, use_flash=False,
+        )
+
+    f = jax.jit(
+        shard_map(
+            body, mesh=seq_mesh,
+            in_specs=(P(None, "intra"),) * 4,
+            out_specs=P(None, "intra"),
+            check_vma=False,
+        )
+    )
+    out = f(qz, kz, vz, segz)[:, inv]
+    ref = segmented_full_attention(q, k, v, seg, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_zigzag_segments_reject_flash_inner(seq_mesh):
+    from chainermn_tpu.parallel.ring_attention import zigzag_ring_attention
+
+    q, k, v = make_qkv()
+    seg = _packed_seg()
+
+    def body(q, k, v, seg):
+        return zigzag_ring_attention(
+            q, k, v, "intra", segment_ids=seg, use_flash=True,
+        )
+
+    with pytest.raises(ValueError, match="dense inner path"):
+        jax.jit(shard_map(
+            body, mesh=seq_mesh, in_specs=(P(None, "intra"),) * 4,
+            out_specs=P(None, "intra"), check_vma=False,
+        ))(q, k, v, seg)
