@@ -483,6 +483,48 @@ def segment_mask(q_segment_ids, kv_segment_ids):
     return q_segment_ids[:, :, None] == kv_segment_ids[:, None, :]
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def flash_attention_with_lse_seg(q, k, v, q_seg, kv_seg, scale, causal,
+                                 block_q, block_k, interpret):
+    """Segment-masked :func:`flash_attention_with_lse` — ``(o, lse)``
+    with both cotangents folding into the explicit backward, plus the
+    packed-sequence masks.  The composition form for segmented
+    ring/zigzag inners."""
+    return _flash_bh_fwd(
+        q, k, v, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+        q_seg=q_seg, kv_seg=kv_seg,
+    )
+
+
+def _flash_lse_seg_vjp_fwd(q, k, v, q_seg, kv_seg, scale, causal, block_q,
+                           block_k, interpret):
+    o, lse = _flash_bh_fwd(
+        q, k, v, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+        q_seg=q_seg, kv_seg=kv_seg,
+    )
+    return (o, lse), (q, k, v, o, lse, q_seg, kv_seg)
+
+
+def _flash_lse_seg_vjp_bwd(scale, causal, block_q, block_k, interpret, res,
+                           cots):
+    q, k, v, o, lse, q_seg, kv_seg = res
+    do, dlse = cots
+    dlse2 = dlse[..., 0] if dlse.ndim == 3 else dlse
+    dq, dk, dv = _flash_bh_bwd(
+        q, k, v, o, lse, do, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret, dlse=dlse2,
+        q_seg=q_seg, kv_seg=kv_seg,
+    )
+    return dq, dk, dv, _float0_like(q_seg), _float0_like(kv_seg)
+
+
+flash_attention_with_lse_seg.defvjp(
+    _flash_lse_seg_vjp_fwd, _flash_lse_seg_vjp_bwd
+)
+
+
 def _xla_attention(q, k, v, scale, causal, q_segment_ids=None,
                    kv_segment_ids=None):
     logits = jnp.einsum(
@@ -601,13 +643,8 @@ def flash_attention(
     kt = k.transpose(0, 2, 1, 3).reshape(B * H, Sk, D)
     vt = v.transpose(0, 2, 1, 3).reshape(B * H, Sk, D)
     if q_segment_ids is not None:
-        # (B, S) → (B*H, S, 1): head index is minor in the BH flattening.
-        qs = jnp.repeat(
-            q_segment_ids.astype(jnp.int32), H, axis=0
-        )[..., None]
-        ks = jnp.repeat(
-            kv_segment_ids.astype(jnp.int32), H, axis=0
-        )[..., None]
+        qs = seg_to_bh(q_segment_ids, H)
+        ks = seg_to_bh(kv_segment_ids, H)
         out = _flash_bh_seg(
             qt, kt, vt, qs, ks, scale, causal, block_q, block_k, interpret
         )
@@ -665,6 +702,12 @@ def from_bh(x, B: int, H: int):
     """(B*H, S, D) → (B, S, H, D)."""
     _, S, D = x.shape
     return x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+def seg_to_bh(ids, H: int):
+    """(B, S) segment ids → the kernel's (B*H, S, 1) layout (head index
+    minor, matching :func:`to_bh`'s flattening)."""
+    return jnp.repeat(ids.astype(jnp.int32), H, axis=0)[..., None]
 
 
 def make_flash_attention_fn(causal: bool = True, q_segment_ids=None,

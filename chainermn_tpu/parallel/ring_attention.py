@@ -192,22 +192,34 @@ def inverse_zigzag_indices(seq_len: int, n_shards: int):
     return inv
 
 
-def _flash_block_stats(q, k, v, causal, scale, block, interpret):
+def _flash_block_stats(q, k, v, causal, scale, block, interpret,
+                       qseg=None, kseg=None):
     """Block stats from the Pallas flash kernel, in `_online_merge`'s
     (m, l, pv) convention: any (m', l', pv') with the same normalized
     output pv/l and the same m + log l is equivalent, so the kernel's
     (o, lse) maps to (lse, 1, o).  Differentiable (the LSE cotangent folds
-    into the kernel backward's residual)."""
+    into the kernel backward's residual).  ``qseg``/``kseg``: optional
+    (B, S) segment ids — the segmented kernel variant masks the block."""
     from chainermn_tpu.ops.flash_attention import (
         flash_attention_with_lse,
+        flash_attention_with_lse_seg,
         from_bh,
+        seg_to_bh,
         to_bh,
     )
 
     B, S, H, D = q.shape
-    o, lse = flash_attention_with_lse(
-        to_bh(q), to_bh(k), to_bh(v), scale, causal, block, block, interpret
-    )
+    if qseg is None:
+        o, lse = flash_attention_with_lse(
+            to_bh(q), to_bh(k), to_bh(v), scale, causal, block, block,
+            interpret,
+        )
+    else:
+        o, lse = flash_attention_with_lse_seg(
+            to_bh(q), to_bh(k), to_bh(v),
+            seg_to_bh(qseg, H), seg_to_bh(kseg, H),
+            scale, causal, block, block, interpret,
+        )
     o4 = from_bh(o, B, H).astype(jnp.float32)
     lse3 = lse[..., 0].reshape(B, H, S)
     return lse3, jnp.ones_like(lse3), o4
@@ -239,9 +251,8 @@ def zigzag_ring_attention(
 
     ``segment_ids``: optional (B, S_local) int32 packed-sequence ids IN
     ZIGZAG LAYOUT (apply the same :func:`zigzag_indices` permutation as
-    the activations); they rotate with the K/V blocks.  Supported on the
-    dense inner path only — combined with ``use_flash=True`` this raises
-    (the flash-with-LSE composition kernel has no segment masks).
+    the activations); they rotate with the K/V blocks, on both the dense
+    inner path and the flash inner (the segmented flash-with-LSE kernel).
     """
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
@@ -262,14 +273,8 @@ def zigzag_ring_attention(
     interpret = jax.default_backend() not in ("tpu", "axon")
     flash_ok, flash_blk = flash_block_plan(C, q.shape[-1], q.dtype, interpret)
     segmented = segment_ids is not None
-    if segmented and use_flash:
-        raise ValueError(
-            "segment_ids are supported on the dense inner path only; "
-            "pass use_flash=False (or None)"
-        )
     if use_flash is None:
-        # off-TPU interpret is slow; segments force the dense path.
-        use_flash = flash_ok and not interpret and not segmented
+        use_flash = flash_ok and not interpret   # off-TPU interpret is slow
     elif use_flash and not flash_ok:
         raise ValueError(
             f"use_flash=True but the kernel block plan refused chunk shape "
@@ -283,7 +288,8 @@ def zigzag_ring_attention(
     def block_stats(qc, kc, vc, causal, qseg=None, kseg=None):
         if use_flash:
             return _flash_block_stats(
-                qc, kc, vc, causal, scale, flash_blk, interpret
+                qc, kc, vc, causal, scale, flash_blk, interpret,
+                qseg=qseg, kseg=kseg,
             )
         mask = tri if causal else None
         if qseg is not None:
@@ -405,8 +411,7 @@ def make_zigzag_ring_attention_fn(axis_name: str, segment_ids=None):
     """Adapter for :func:`zigzag_ring_attention` (always causal; inputs
     must be in zigzag shard layout, see :func:`zigzag_indices`).
     ``segment_ids``: optional row-uniform GLOBAL (S,) ids ALREADY in
-    zigzag layout (apply the same permutation as the tokens); dense inner
-    path only."""
+    zigzag layout (apply the same permutation as the tokens)."""
 
     def fn(q, k, v, mask=None):
         del mask

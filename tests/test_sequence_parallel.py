@@ -437,19 +437,46 @@ def test_zigzag_segments_match_oracle(seq_mesh):
     )
 
 
-def test_zigzag_segments_reject_flash_inner(seq_mesh):
-    from chainermn_tpu.parallel.ring_attention import zigzag_ring_attention
+@pytest.mark.slow
+def test_zigzag_segments_flash_inner_matches_dense(seq_mesh):
+    """The segmented FLASH inner (flash_attention_with_lse_seg inside the
+    zigzag ring) must match the dense inner exactly — fwd and bwd."""
+    from chainermn_tpu.parallel.ring_attention import (
+        zigzag_indices, zigzag_ring_attention,
+    )
 
-    q, k, v = make_qkv()
-    seg = _packed_seg()
+    B, S = 2, 1024  # chunk C=128: satisfies the interpret block plan
+    q, k, v = make_qkv(B=B, S=S, H=2, D=8)
+    seg = np.zeros((B, S), np.int32)
+    seg[:, 300:] = 1  # boundary inside shard 1
+    perm = zigzag_indices(S, 4)
+    qz, kz, vz = (t[:, perm] for t in (q, k, v))
+    segz = jnp.asarray(seg[:, perm])
 
-    def body(q, k, v, seg):
-        return zigzag_ring_attention(
-            q, k, v, "intra", segment_ids=seg, use_flash=True,
-        )
+    def run(use_flash):
+        def body(q, k, v, seg):
+            return zigzag_ring_attention(
+                q, k, v, "intra", segment_ids=seg, use_flash=use_flash,
+            )
 
-    with pytest.raises(ValueError, match="dense inner path"):
-        jax.jit(shard_map(
+        f = shard_map(
             body, mesh=seq_mesh, in_specs=(P(None, "intra"),) * 4,
             out_specs=P(None, "intra"), check_vma=False,
-        ))(q, k, v, seg)
+        )
+
+        def loss(q, k, v):
+            return jnp.sum(jnp.sin(f(q, k, v, segz)))
+
+        out = jax.jit(f)(qz, kz, vz, segz)
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(qz, kz, vz)
+        return out, g
+
+    out_f, g_f = run(True)
+    out_d, g_d = run(False)
+    np.testing.assert_allclose(
+        np.asarray(out_f), np.asarray(out_d), rtol=2e-5, atol=2e-5
+    )
+    for a, b in zip(g_f, g_d):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4
+        )
