@@ -40,6 +40,37 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def collective_profile(comm, nbytes: int, dtype) -> dict:
+    """Per-communicator collective-op counts from the traced
+    ``allreduce_grad`` lowering (jaxpr-level, environment-independent).
+
+    Recorded alongside every bandwidth number so a future multi-chip run
+    is one command AND the algorithm each backend actually lowered to is
+    pinned in the same JSON line (e.g. two_dimensional must show
+    psum_scatter + psum + all_gather; xla_ici one fused psum)."""
+    import jax
+
+    n = comm.device_size
+    elems = max(1, nbytes // np.dtype(dtype).itemsize)
+    spec = comm._world_spec
+
+    def body(tree):
+        sq = jax.tree.map(lambda x: jnp.squeeze(x, 0), tree)
+        out = comm.allreduce_grad(sq)
+        return jax.tree.map(lambda x: x[None], out)
+
+    jx = str(jax.make_jaxpr(comm.shard_map(
+        body, in_specs=({"g": spec},), out_specs={"g": spec}
+    ))({"g": jnp.ones((n, elems), dtype)}))
+    # lax.psum_scatter traces to the `reduce_scatter` primitive.
+    return {
+        "psum": jx.count("psum"),
+        "reduce_scatter": jx.count("reduce_scatter"),
+        "all_gather": jx.count("all_gather"),
+        "ppermute": jx.count("ppermute"),
+    }
+
+
 def bench_one(comm, nbytes: int, dtype, iters: int, warmup: int) -> dict:
     n = comm.device_size
     elems_per_dev = max(1, nbytes // np.dtype(dtype).itemsize)
@@ -106,6 +137,7 @@ def bench_one(comm, nbytes: int, dtype, iters: int, warmup: int) -> dict:
         "unit": "GB/s",
         "time_ms": round(dt * 1e3, 4),
         "algo_bw_GBps": round(payload / dt / 1e9, 4),
+        "hlo_collectives": collective_profile(comm, nbytes, dtype),
     }
 
 
