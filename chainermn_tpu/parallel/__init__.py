@@ -16,6 +16,6 @@ from chainermn_tpu.parallel.sharding import (  # noqa: F401
 def __getattr__(name):
     import importlib
 
-    if name in ("ring_attention", "ulysses", "pipeline"):
+    if name in ("ring_attention", "ulysses", "pipeline", "moe"):
         return importlib.import_module(f"chainermn_tpu.parallel.{name}")
     raise AttributeError(name)
