@@ -287,11 +287,22 @@ def pipeline_interleaved_1f1b_loss_and_grads(
     bubble is ``2(n-1)`` whole-stage times = ``2v(n-1)`` chunk-times for
     the same total depth, so this round-based schedule cuts the bubble by
     ``~(v+1)/2v`` — a factor approaching 2 at large ``v``, NOT the
-    ``1/v`` of Megatron-LM's tighter (and considerably more intricate)
-    warmup, whose steady state admits later rounds inside the first
-    round's laps.  The ``2(L-1)``-tick forward->backward dependency of
-    microbatch 0's stage 0 is schedule-independent; the remaining gap to
-    Megatron's bound is all in the drain tail.
+    ``1/v`` of Megatron-LM's tighter schedule.
+
+    That residual gap is structural, not sloppiness: within this
+    schedule each device's forward slot stream is GAPLESS over
+    ``[idx, Mv + idx)`` and its backward slot stream is gapless over
+    ``[2(L-1) - idx, ...)``; the whole bubble is the dependency-forced
+    phase offset between the two streams (microbatch 0's stage-0
+    backward cannot fire before tick ``2(L-1)``), which a lockstep
+    one-``ppermute``-stream SPMD program cannot compress — every arrival
+    must be served the tick it lands, so admissions cannot be deferred
+    into it.  Megatron's schedule beats it only by buffering in-flight
+    activations and reordering per-device work (MIMD-style), which in
+    SPMD means carrying an explicit multi-slot arrival queue with
+    data-dependent selection (MaxText's ``circ_storage``) — a trade of
+    considerable program complexity and extra live activations for the
+    last ``~n(v-1)`` ticks of bubble.
 
     Memory: the saved-input ring holds ``2L - 1`` microbatch activations
     (each chunk's backward recomputes only ITS chunk) versus ``2n - 1``
