@@ -59,15 +59,33 @@ def collective_profile(comm, nbytes: int, dtype) -> dict:
         out = comm.allreduce_grad(sq)
         return jax.tree.map(lambda x: x[None], out)
 
-    jx = str(jax.make_jaxpr(comm.shard_map(
+    jaxpr = jax.make_jaxpr(comm.shard_map(
         body, in_specs=({"g": spec},), out_specs={"g": spec}
-    ))({"g": jnp.ones((n, elems), dtype)}))
+    ))({"g": jnp.ones((n, elems), dtype)})
+
+    # Exact primitive-name counts, recursing into inner jaxprs (the
+    # collectives live inside the shard_map eqn) — not text substrings,
+    # which would also match any psum-/all_gather-variant names.
+    counts: dict = {}
+
+    def walk(jp):
+        for eqn in jp.eqns:
+            counts[eqn.primitive.name] = (
+                counts.get(eqn.primitive.name, 0) + 1
+            )
+            for val in eqn.params.values():
+                # Inner jaxprs appear as raw Jaxpr (has .eqns) or
+                # ClosedJaxpr (has .jaxpr) param values.
+                if hasattr(val, "eqns"):
+                    walk(val)
+                elif hasattr(val, "jaxpr"):
+                    walk(val.jaxpr)
+
+    walk(jaxpr.jaxpr)
     # lax.psum_scatter traces to the `reduce_scatter` primitive.
     return {
-        "psum": jx.count("psum"),
-        "reduce_scatter": jx.count("reduce_scatter"),
-        "all_gather": jx.count("all_gather"),
-        "ppermute": jx.count("ppermute"),
+        key: counts.get(key, 0)
+        for key in ("psum", "reduce_scatter", "all_gather", "ppermute")
     }
 
 

@@ -228,6 +228,11 @@ class MultiprocessBatchLoader:
         for e in epochs:
             yield from self._epoch_batches(e)
 
+    def __bool__(self):
+        # Without this, bool(loader) falls back to __len__, which raises
+        # for repeat=True — truthiness must stay cheap and total.
+        return True
+
     def __len__(self):
         if self._repeat:
             raise TypeError(

@@ -116,13 +116,15 @@ def ring_attention(
 
     perm = [(i, (i + 1) % n) for i in range(n)]
     segmented = q_segment_ids is not None
-    seg0 = (
-        kv_segment_ids.astype(jnp.int32) if segmented
-        else jnp.zeros((B, S), jnp.int32)  # carried but unused
-    )
 
     def body(carry, j):
-        k_blk, v_blk, seg_blk, acc, m_run, l_run = carry
+        # Segment ids ride the carry ONLY when segmented — a dead zeros
+        # tensor would still be saved/rematerialized by jax.checkpoint.
+        if segmented:
+            k_blk, v_blk, seg_blk, acc, m_run, l_run = carry
+        else:
+            k_blk, v_blk, acc, m_run, l_run = carry
+            seg_blk = None
         src = (my - j) % n                   # originating rank of this block
         k_pos = src * S + jnp.arange(S)
         if causal:
@@ -142,18 +144,21 @@ def ring_attention(
         # keeps the program static).
         k_nxt = lax.ppermute(k_blk, axis_name, perm)
         v_nxt = lax.ppermute(v_blk, axis_name, perm)
-        seg_nxt = (
-            lax.ppermute(seg_blk, axis_name, perm) if segmented else seg_blk
-        )
-        return (k_nxt, v_nxt, seg_nxt, acc_new, m_new, l_new), None
+        tail = (acc_new, m_new, l_new)
+        if segmented:
+            seg_nxt = lax.ppermute(seg_blk, axis_name, perm)
+            return (k_nxt, v_nxt, seg_nxt) + tail, None
+        return (k_nxt, v_nxt) + tail, None
 
     acc0 = jnp.zeros((B, S, H, D), jnp.float32)
     m0 = jnp.full((B, H, S), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((B, H, S), jnp.float32)
 
-    (_, _, _, acc, _, l), _ = lax.scan(
-        jax.checkpoint(body), (k, v, seg0, acc0, m0, l0), jnp.arange(n)
-    )
+    carry0 = (k, v) + (
+        (kv_segment_ids.astype(jnp.int32),) if segmented else ()
+    ) + (acc0, m0, l0)
+    out_carry, _ = lax.scan(jax.checkpoint(body), carry0, jnp.arange(n))
+    acc, l = out_carry[-3], out_carry[-1]
 
     denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return (acc / denom).astype(q.dtype)
@@ -306,11 +311,11 @@ def zigzag_ring_attention(
             jnp.zeros((B, C, H, D), jnp.float32),
         )
 
-    seg = (
-        segment_ids.astype(jnp.int32) if segmented
-        else jnp.zeros((B, S), jnp.int32)  # carried but unused
-    )
-    sega, segb = seg[:, :C], seg[:, C:]
+    if segmented:
+        seg = segment_ids.astype(jnp.int32)
+        sega, segb = seg[:, :C], seg[:, C:]
+    else:
+        seg = sega = segb = None
 
     def segargs(qseg, kseg):
         return (qseg, kseg) if segmented else (None, None)
@@ -329,20 +334,28 @@ def zigzag_ring_attention(
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def body(carry, j):
-        k_blk, v_blk, seg_blk, sa, sb = carry
+        # Segment ids ride the carry ONLY when segmented (a dead zeros
+        # tensor would still be saved/rematerialized by jax.checkpoint).
+        if segmented:
+            k_blk, v_blk, seg_blk, sa, sb = carry
+            seg_blk = lax.ppermute(seg_blk, axis_name, perm)
+        else:
+            k_blk, v_blk, sa, sb = carry
+            seg_blk = None
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        seg_blk = (
-            lax.ppermute(seg_blk, axis_name, perm) if segmented else seg_blk
-        )
         # After j rotations the block originates at rank (my - j) mod n.
         early_live = my >= j           # src strictly behind: a·ka live
         # One conditional half-block: a·ka when early_live, else b·kb.
         q_in = jnp.where(early_live, qa, qb)
         k_in = jnp.where(early_live, k_blk[:, :C], k_blk[:, C:])
         v_in = jnp.where(early_live, v_blk[:, :C], v_blk[:, C:])
-        qseg_in = jnp.where(early_live, sega, segb)
-        kseg_in = jnp.where(early_live, seg_blk[:, :C], seg_blk[:, C:])
+        if segmented:
+            qseg_in = jnp.where(early_live, sega, segb)
+            kseg_in = jnp.where(early_live, seg_blk[:, :C], seg_blk[:, C:])
+            kseg_early = seg_blk[:, :C]
+        else:
+            qseg_in = kseg_in = kseg_early = None
         blk2 = block_stats(
             q_in, k_in, v_in, False, *segargs(qseg_in, kseg_in)
         )
@@ -351,13 +364,16 @@ def zigzag_ring_attention(
         # Late chunk b always attends the received early chunk ka.
         sb = _online_merge(sb, block_stats(
             qb, k_blk[:, :C], v_blk[:, :C], False,
-            *segargs(segb, seg_blk[:, :C])
+            *segargs(segb, kseg_early)
         ))
-        return (k_blk, v_blk, seg_blk, sa, sb), None
+        out = (k_blk, v_blk) + ((seg_blk,) if segmented else ()) + (sa, sb)
+        return out, None
 
-    (_, _, _, sa, sb), _ = lax.scan(
-        jax.checkpoint(body), (k, v, seg, sa, sb), jnp.arange(1, n)
+    carry0 = (k, v) + ((seg,) if segmented else ()) + (sa, sb)
+    out_carry, _ = lax.scan(
+        jax.checkpoint(body), carry0, jnp.arange(1, n)
     )
+    sa, sb = out_carry[-2], out_carry[-1]
 
     def finish(stats):
         m, l, acc = stats
@@ -376,6 +392,14 @@ def _local_seg_slice(segment_ids, axis_name, s_local, batch):
             f"adapter segment_ids must be row-uniform GLOBAL (S,), got "
             f"shape {segment_ids.shape} — per-row (B, S) ids go to "
             "ring_attention/ulysses_attention directly (as LOCAL shards)"
+        )
+    n = lax.axis_size(axis_name)
+    if segment_ids.shape[0] != s_local * n:
+        # dynamic_slice CLAMPS out-of-range starts — wrong-length ids
+        # would silently give every shard the same trailing window.
+        raise ValueError(
+            f"adapter segment_ids length {segment_ids.shape[0]} != global "
+            f"sequence {s_local} * {n} shards = {s_local * n}"
         )
     my = lax.axis_index(axis_name)
     row = lax.dynamic_slice_in_dim(

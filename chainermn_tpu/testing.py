@@ -30,8 +30,15 @@ class DummyCommunicator:
             return group[root]._mailbox.get("bcast", obj)
         return obj
 
-    def gather_obj(self, obj, root: int = 0):
-        return [obj] * self.size if self.size > 1 else [obj]
+    def gather_obj(self, obj, root: "int | None" = None):
+        # Mirror the real contract exactly: root=None → allgather (full
+        # list everywhere); root=r → list at root, None elsewhere — a
+        # double that hid the None would green-light wrappers that crash
+        # on a real communicator.
+        full = [obj] * self.size if self.size > 1 else [obj]
+        if root is None:
+            return full
+        return full if self.rank == root else None
 
     def allgather_obj(self, obj):
         return self.gather_obj(obj)

@@ -131,7 +131,9 @@ class CollectiveTrace:
                 logs = self._comm.gather_obj(self._sym, root=0)
             else:
                 logs = self._comm.gather_obj(self._sym)
-            if logs is None or self._comm.rank != 0:
+            if logs is None:
+                # Point-to-root path, non-root rank: the detail lives at
+                # rank 0 by design (that is the wire saving).
                 raise RuntimeError(
                     f"collective order mismatch across hosts: fingerprints "
                     f"{fps}; rank 0 holds the first differing call"
