@@ -44,6 +44,7 @@ import functools
 import math
 import os
 import pickle
+import warnings
 from typing import Any, Callable, Sequence
 
 import jax
@@ -58,6 +59,35 @@ try:  # jax >= 0.4.35
     from jax import shard_map as _shard_map
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
+
+
+_PPERMUTE_FALLBACK_WARNED = False
+
+
+def _warn_ppermute_fallback(world: int) -> None:
+    """One-time warning when ``ppermute`` hits its general fallback.
+
+    The fallback is ``all_gather`` + slice: correct for arbitrary
+    permutations, but it moves ``world × message`` bytes instead of the
+    O(message) the factored paths move.  No in-tree caller reaches it, so
+    user code arriving here is almost always an unintended routing pattern
+    worth restructuring (e.g. into per-axis maps or a uniform ring shift).
+    """
+    global _PPERMUTE_FALLBACK_WARNED
+    if _PPERMUTE_FALLBACK_WARNED:
+        return
+    _PPERMUTE_FALLBACK_WARNED = True
+    warnings.warn(
+        "ppermute: permutation does not factor per-axis and is not a "
+        f"uniform flat shift; falling back to all_gather over all "
+        f"{world} devices + slice.  This moves world-volume "
+        f"({world}x message) bytes per call.  Restructure the "
+        "permutation (per-axis injective maps, or a constant "
+        "(dst-src) % world shift) to get the O(message) paths.  "
+        "This warning is emitted once per process.",
+        RuntimeWarning,
+        stacklevel=3,
+    )
 
 
 def _tree_cast(tree, dtype):
@@ -415,6 +445,7 @@ class CommunicatorBase:
             return self._mask_non_dsts(xq, perm)
 
         # (3) general fallback: collapse via all_gather + slice.
+        _warn_ppermute_fallback(n)
         src_for_dst = {d: s for s, d in perm}
         gathered = lax.all_gather(x, self.axes, axis=0)
         idx = self.axis_index()
@@ -694,7 +725,8 @@ class CommunicatorBase:
         out = multihost_utils.broadcast_one_to_all(buf, is_source=self.rank == root)
         return pickle.loads(np.asarray(out).tobytes())
 
-    def gather_obj(self, obj, root: int | None = None):
+    def gather_obj(self, obj, root: int | None = None,
+                   timeout_ms: int | None = None):
         """Gather every process's object.
 
         ``root=None`` (default): allgather semantics — the full list on
@@ -705,16 +737,25 @@ class CommunicatorBase:
         (REF:chainermn/communicators/mpi_communicator_base.py ``gather``)
         — every non-root sends ONLY to root (O(n * payload) total wire,
         non-root processes fetch nothing) and the list is returned at
-        root, ``None`` elsewhere.
+        root, ``None`` elsewhere.  ``timeout_ms`` bounds root's wait on
+        EACH member's payload (the same contract ``recv_obj`` has), so a
+        member that died before sending raises ``TimeoutError`` at root
+        instead of blocking forever.
 
         Payloads travel at their exact size — no pad-to-max."""
+        if timeout_ms is not None and root is None:
+            raise ValueError(
+                "gather_obj: timeout_ms is only supported with root=... "
+                "(the point-to-root path); the allgather path has no "
+                "bounded-wait implementation and would silently ignore it"
+            )
         if self.size == 1:
             return [obj]
         if root is not None:
             if not (0 <= root < self.size):
                 raise ValueError(f"gather_obj root {root} out of range")
             self._require_kv("gather_obj(root=...)")
-            return self._obj_plane.gather(obj, root)
+            return self._obj_plane.gather(obj, root, timeout_ms=timeout_ms)
         if kvtransport.available():
             return self._obj_plane.allgather(obj)
         self._require_subgroup_kv("gather_obj")
@@ -865,7 +906,15 @@ class CommunicatorBase:
         from .xla_ici import XlaIciCommunicator
 
         out: dict = {}
-        for c in sorted(groups):  # deterministic construction order (SPMD)
+        # Deterministic construction order (SPMD).  Colors are unrestricted
+        # by the API — mixed types must not raise sorted()'s unordered-types
+        # TypeError, and the key must be identical on EVERY process (a
+        # repr()-based key would embed id() for default-repr objects and
+        # desynchronize plane ordinals across ranks).  Each group's lowest
+        # member flat-rank is total, collision-free, and process-invariant.
+        for c in sorted(
+            groups, key=lambda c: min(r for _k, r, _d in groups[c])
+        ):
             lst = sorted(groups[c], key=lambda t: (t[0], t[1]))
             devs = [d for _k, _r, d in lst]
             procs = sorted({d.process_index for d in devs})

@@ -241,7 +241,9 @@ def put_payload(key: str, obj) -> None:
 def _blocking_get(fn, key: str, deadline: float | None):
     """Call a blocking KV getter, waiting until ``deadline`` (monotonic
     seconds; None = forever), polling in ``POLL_SLICE_MS`` slices.
-    Non-deadline errors propagate immediately."""
+    Non-deadline errors propagate immediately; deadline expiry raises
+    ``TimeoutError`` so callers see the same exception type on both
+    transports (the socket plane's ``recv`` already raises it)."""
     while True:
         if deadline is None:
             slice_ms = POLL_SLICE_MS
@@ -256,7 +258,10 @@ def _blocking_get(fn, key: str, deadline: float | None):
             if not _is_deadline(e):
                 raise
             if deadline is not None and time.monotonic() >= deadline:
-                raise
+                raise TimeoutError(
+                    f"KV get of {key!r} expired its caller deadline "
+                    f"({type(e).__name__} from the client)"
+                ) from e
 
 
 def _get_chunks_into(c, key: str, n: int, chunk: int, out, deadline) -> None:
@@ -823,13 +828,17 @@ class ObjectPlane:
         self._commit(slot)
         return out
 
-    def gather(self, obj, root: int) -> "list | None":
+    def gather(self, obj, root: int, *,
+               timeout_ms: int | None = None) -> "list | None":
         """Point-to-root gather (the reference ``MPI_Gather`` wire
         profile): every non-root sends its payload ONLY to root — O(n *
         payload) total wire, and non-root processes fetch NOTHING — where
         :meth:`allgather` costs O(n^2) total.  Returns the subgroup-
         ordered list at root, None elsewhere.  p2p-shaped, so payloads
-        ride the socket data plane in a dedicated route namespace."""
+        ride the socket data plane in a dedicated route namespace.
+        ``timeout_ms`` bounds root's wait per member (``recv_obj``'s
+        contract) so a dead sender surfaces as ``TimeoutError``, not a
+        hang."""
         self._ensure_validated()
         groot = self.members[root]
         slot = ("pgather", groot)
@@ -841,10 +850,11 @@ class ObjectPlane:
                 if g == groot:
                     out.append(obj)
                 elif self._use_sockets:
-                    out.append(socket_plane(self.rank).recv(ns, g, 0, seq))
+                    out.append(socket_plane(self.rank).recv(
+                        ns, g, 0, seq, timeout_ms=timeout_ms))
                 else:
                     key = self._key("pgather", groot, g, seq)
-                    got, n = get_payload(key)
+                    got, n = get_payload(key, timeout_ms=timeout_ms)
                     delete(key, n)  # sole reader
                     out.append(got)
             self._commit(slot)

@@ -30,11 +30,17 @@ class DummyCommunicator:
             return group[root]._mailbox.get("bcast", obj)
         return obj
 
-    def gather_obj(self, obj, root: "int | None" = None):
+    def gather_obj(self, obj, root: "int | None" = None,
+                   timeout_ms: "int | None" = None):
         # Mirror the real contract exactly: root=None → allgather (full
         # list everywhere); root=r → list at root, None elsewhere — a
         # double that hid the None would green-light wrappers that crash
-        # on a real communicator.
+        # on a real communicator.  timeout_ms is accepted (and, like the
+        # real contract, rejected without root) but nothing here blocks.
+        if timeout_ms is not None and root is None:
+            raise ValueError(
+                "gather_obj: timeout_ms is only supported with root=..."
+            )
         full = [obj] * self.size if self.size > 1 else [obj]
         if root is None:
             return full

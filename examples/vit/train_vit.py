@@ -115,7 +115,11 @@ def main(argv=None):
     # position s across all inter rows.  Stage s's grads could be
     # averaged on stage_dp[s] alone; here they sanity-check the topology.
     stage_dp = comm.split_devices([r % pp for r in range(comm.device_size)])
-    assert all(sub.device_size == dp for sub in stage_dp.values())
+    # A color whose devices all live on other processes maps to None
+    # (MPI_COMM_NULL) — skip those rather than AttributeError on None.
+    assert all(
+        sub is None or sub.device_size == dp for sub in stage_dp.values()
+    )
     if comm.rank == 0:
         print(f"mesh: data={dp} x pipeline={pp} "
               f"(+{len(stage_dp)} per-stage DP subgroups); "

@@ -348,3 +348,42 @@ def test_checkpointer_async_cleanup_no_leak(tmp_path):
         assert f"snapshot_iter_3.rank{r}" in names
     got, it = cps[1].maybe_load(state)
     assert it == 3
+
+
+def test_jax_array_committed_contract_pin():
+    """ADVICE r4: ``_restore_leaf`` keys restore placement off the private
+    ``jax.Array._committed`` attribute with a ``getattr`` default of True.
+    Pin the jax-internal contract here so a jax rename/behavior change
+    fails THIS test loudly instead of silently making every
+    fully-addressable restore committed (reinstating the shard_map
+    device-mismatch the branch exists to prevent)."""
+    x = jax.jit(lambda: jnp.ones((2,)))()
+    # The attribute must exist on ordinary jit outputs...
+    assert hasattr(x, "_committed"), (
+        "jax.Array._committed disappeared — update "
+        "chainermn_tpu/extensions/checkpoint.py::_restore_leaf, which "
+        "derives restore placement from it"
+    )
+    # ...and keep its meaning: jit outputs with no explicit placement are
+    # uncommitted; explicit device_put commits.
+    assert x._committed is False
+    y = jax.device_put(np.ones((2,)), jax.devices()[0])
+    assert y._committed is True
+
+
+def test_restore_leaf_keeps_uncommitted_as_host_array():
+    """Behavioral half of the pin: an uncommitted fully-addressable
+    template restores as a host array (jit keeps placement freedom); a
+    committed template restores placed."""
+    from chainermn_tpu.extensions.checkpoint import _restore_leaf
+
+    saved = np.arange(4.0, dtype=np.float32)
+    uncommitted_tpl = jax.jit(lambda: jnp.zeros((4,), jnp.float32))()
+    out = _restore_leaf(uncommitted_tpl, saved)
+    assert not isinstance(out, jax.Array) or not out._committed
+    committed_tpl = jax.device_put(
+        np.zeros(4, np.float32), jax.devices()[0]
+    )
+    out2 = _restore_leaf(committed_tpl, saved)
+    assert isinstance(out2, jax.Array) and out2._committed
+    np.testing.assert_array_equal(np.asarray(out2), saved)

@@ -213,3 +213,42 @@ def test_oversized_send_raises_on_sender(sock_pair, monkeypatch):
     monkeypatch.setattr(kv, "MAX_FRAME_BYTES", 1024)
     with pytest.raises(ValueError, match="CHAINERMN_TPU_MAX_FRAME_BYTES"):
         p0.send("c", 1, 0, 0, np.zeros(4096, np.float64))
+
+
+def test_object_plane_gather_root_timeout(monkeypatch):
+    """ADVICE r4: point-to-root gather must honor timeout_ms at root so a
+    member that died before sending raises instead of blocking forever.
+    KV-fallback path (sockets off), dict-backed fake KV, member 1 never
+    sends."""
+    from jax.errors import JaxRuntimeError
+
+    class FullFake(FakeKvClient):
+        def blocking_key_value_get(self, k, timeout_ms):
+            # Mimic the real client's deadline surface (the gRPC
+            # DEADLINE_EXCEEDED status as a JaxRuntimeError) so
+            # _is_deadline recognizes it and _blocking_get translates
+            # expiry to TimeoutError.
+            try:
+                return super().blocking_key_value_get(k, timeout_ms)
+            except RuntimeError as e:
+                raise JaxRuntimeError(str(e)) from None
+
+        def key_value_set_bytes(self, k, v):
+            self.key_value_set(k, bytes(v))
+
+        def blocking_key_value_get_bytes(self, k, timeout_ms):
+            return self.blocking_key_value_get(k, timeout_ms)
+
+        def key_value_delete(self, k):
+            with self.cv:
+                self.d.pop(k, None)
+
+    fake = FullFake()
+    monkeypatch.setattr(kv, "client", lambda: fake)
+    monkeypatch.setattr(kv, "available", lambda: True)
+    monkeypatch.setattr(kv.ObjectPlane, "_use_sockets", False)
+    root = kv.ObjectPlane("gt", rank=0, size=2, site="t:1")
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):  # same type as the socket plane's
+        root.gather("root-obj", 0, timeout_ms=300)
+    assert time.monotonic() - t0 < 10.0  # bounded, not a hang
