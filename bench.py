@@ -1,30 +1,35 @@
 #!/usr/bin/env python
-"""Headline benchmark: ResNet-50 ImageNet-shape training throughput,
-images/sec/chip — the metric BASELINE.json tracks.
+"""Headline benchmarks, printed as ONE JSON line.
 
-Runs the FULL data-parallel training step (forward, backward, gradient
-allreduce via the xla_ici communicator, SGD+momentum update, cross-replica
-BatchNorm sync) on whatever devices are visible — the single real TPU chip
-under the driver, a CPU mesh when forced.
+Two flagships, both FULL training steps on whatever devices are visible
+(the single real TPU chip under the driver; a CPU mesh when forced):
 
-``vs_baseline``: the reference stack's public record is ResNet-50/ImageNet
-in 15 min on 1024 P100s (arXiv:1711.04325) → 1.28M images × 90 epochs /
-900 s / 1024 chips ≈ 125 images/sec/chip.  That is the per-chip rate this
-number is measured against (>1.0 = beating the reference's chips).
+* **ResNet-50 ImageNet-shape** (the reference's own headline): forward,
+  backward, gradient allreduce via the xla_ici communicator, SGD+momentum,
+  cross-replica BatchNorm sync — images/sec/chip, the metric BASELINE.json
+  tracks.  ``vs_baseline``: the reference stack's public record is
+  ResNet-50/ImageNet in 15 min on 1024 P100s (arXiv:1711.04325) → 1.28M
+  images × 90 epochs / 900 s / 1024 chips ≈ 125 images/sec/chip.
+* **Decoder-only transformer LM** (this framework's own kernels): flash
+  attention (Pallas) + chunked fused cross-entropy (no materialized
+  logits) + per-layer remat, bf16 compute, AdamW — tokens/sec/chip and
+  model-FLOPs utilization against the chip's bf16 peak.  This is the
+  number the long-context/sequence-parallel tier is built to move; the
+  reference has no comparable headline, so its ``mfu`` IS the claim.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The headline line keeps the ResNet metric for baseline continuity and
+embeds the LM result under ``"lm"``.  ``--only {resnet,lm}`` runs one.
 
-``--pipeline`` measures the same step fed by the REAL host input
+``--pipeline`` measures the ResNet step fed by the REAL host input
 pipeline — ``datasets.MultiprocessBatchLoader`` (worker processes
 assembling batches into shared-memory slots) staged through
 ``create_prefetch_iterator`` (background device_put thread) — instead of
 a resident synthetic batch, so the number includes host batch assembly
-and host→device transfer overlapped with compute.  Same single-JSON-line
-contract, different metric name.  Caveat for THIS environment: the axon
-tunnel's bulk DMA degrades ~75× once the step executable has run (see
-docs/performance.md "Host input pipeline"), so the end-to-end number is
-transfer-bound at ~20 MB/s here; the pipeline's own stage rates are
-measured in isolation and recorded alongside.
+and host→device transfer overlapped with compute.  Caveat for THIS
+environment: the axon tunnel's bulk DMA degrades ~75× once the step
+executable has run (see docs/performance.md "Host input pipeline"), so
+the end-to-end number is transfer-bound at ~20 MB/s here; the pipeline's
+own stage rates are measured in isolation and recorded alongside.
 """
 
 import argparse
@@ -36,9 +41,9 @@ import jax
 
 from chainermn_tpu.utils.profiling import setup_compilation_cache
 
-# Persistent compilation cache: ResNet-50's train step is a big program and
-# this environment's remote-compile path is slow; cache compiles across
-# bench runs (first run pays, reruns are seconds).
+# Persistent compilation cache: these are big step programs and this
+# environment's remote-compile path is slow; cache compiles across bench
+# runs (first run pays, reruns are seconds).
 setup_compilation_cache()
 
 import jax.numpy as jnp
@@ -46,9 +51,10 @@ import numpy as np
 import optax
 
 import chainermn_tpu
-from chainermn_tpu.models.resnet import ResNet50
+from chainermn_tpu.utils.profiling import slope_time, sync
 
 REFERENCE_IMAGES_PER_SEC_PER_CHIP = 125.0  # P100, ChainerMN pure_nccl era
+V5E_BF16_PEAK = 197e12  # TPU v5e paper peak, bf16 FLOP/s/chip
 
 
 class SyntheticItems:
@@ -69,29 +75,30 @@ class SyntheticItems:
         return self.base[i % len(self.base)], np.int32(i % self.n_classes)
 
 
-def main(argv=None):
-    ap = argparse.ArgumentParser()
-    ap.add_argument(
-        "--pipeline", action="store_true",
-        help="feed the step through the real host input pipeline "
-             "(multiprocess shared-memory loader + prefetch) instead of a "
-             "resident batch",
-    )
-    ap.add_argument(
-        "--loader-workers", type=int, default=2,
-        help="worker processes for --pipeline batch assembly",
-    )
-    ap.add_argument(
-        "--per-chip-batch", type=int, default=256,
-        help="per-device batch (256 = measured optimum; see sweep note)",
-    )
-    ap.add_argument(
-        "--input-dtype", choices=["float32", "bfloat16"], default="float32",
-        help="dtype of the fed batch (model casts to bf16 internally "
-             "either way; bfloat16 halves the feed bytes)",
-    )
-    args = ap.parse_args(argv)
-    comm = chainermn_tpu.create_communicator("xla_ici")
+def _compiled_flops_per_device(lowerable, *args, fallback):
+    """Per-device model FLOPs from XLA's cost model on the compiled step
+    (post-SPMD-partitioned module); the analytic figure on backends whose
+    cost analysis is unavailable."""
+    try:
+        ca = lowerable.lower(*args).compile().cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        return float(ca["flops"])
+    except Exception:
+        return fallback
+
+
+def _median_slope(run, n1=5, repeats=3):
+    """Median of >= 3 independent slope measurements with the spread —
+    the tunneled chip shows real run-to-run variance (r2 2742 vs r3 2536
+    img/s was indistinguishable from tunnel noise without it), so one
+    sample is not a number."""
+    samples = sorted(slope_time(run, n1) for _ in range(repeats))
+    return samples[len(samples) // 2], samples
+
+
+def bench_resnet(comm, args):
+    from chainermn_tpu.models.resnet import ResNet50
+
     n_dev = comm.device_size
     # 256/chip: measured optimum on a v5e-class chip (slope-timed r2:
     # 256→2638, 512→2448 img/s; the r1 sweep's 64→1908, 128→2206 low end
@@ -165,36 +172,28 @@ def main(argv=None):
     # (per-device) module (~23.9 GFLOP/image at batch 256, consistent
     # with the analytic ~3x4.1 GMACs/image incl. backward + update).
     # Lowering the jitted `step` itself (not a fresh wrapper) reuses the
-    # same executable-cache entry the timed loop runs.  Fall back to the
-    # analytic figure if the backend's cost analysis is unavailable.
-    try:
-        ca = step.lower(params, state, batch_stats, (x, y)).compile().cost_analysis()
-        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-        step_flops_per_dev = float(ca["flops"])
-    except Exception:
-        step_flops_per_dev = 24.6e9 * per_chip_batch
-
-    # Warmup (compile + stabilize).  sync() is a device→host readback, NOT
-    # block_until_ready: some PJRT backends report buffers ready at dispatch
-    # time, and a readback is the only barrier that cannot lie.  Each step
-    # consumes the previous step's (donated) params, so the final readback
-    # transitively waits for the whole timed chain.
-    from chainermn_tpu.utils.profiling import sync
+    # same executable-cache entry the timed loop runs.
+    step_flops_per_dev = _compiled_flops_per_device(
+        step, params, state, batch_stats, (x, y),
+        fallback=24.6e9 * per_chip_batch,
+    )
 
     def next_batch():
         if batch_source is None:
             return (x, y)
         return next(batch_source)
 
+    # Warmup (compile + stabilize).  sync() is a device→host readback, NOT
+    # block_until_ready: some PJRT backends report buffers ready at dispatch
+    # time, and a readback is the only barrier that cannot lie.  Each step
+    # consumes the previous step's (donated) params, so the final readback
+    # transitively waits for the whole timed chain.
     for _ in range(3):
         params, state, batch_stats, loss = step(
             params, state, batch_stats, next_batch()
         )
     sync(loss)
 
-    # Slope timing (profiling.slope_time): a single 10-step window would
-    # absorb the tunneled chip's ~100 ms readback as ~10% phantom step
-    # time; the 5-vs-25-step slope cancels it.
     def run(n):
         nonlocal params, state, batch_stats
         t0 = time.perf_counter()
@@ -205,25 +204,16 @@ def main(argv=None):
         sync(loss)
         return time.perf_counter() - t0
 
-    from chainermn_tpu.utils.profiling import slope_time
-
-    # Median of >= 3 independent slope measurements, with the spread
-    # recorded: the tunneled chip shows real run-to-run variance (r2
-    # 2742 vs r3 2536 img/s was indistinguishable from tunnel noise
-    # without it), so one sample is not a number.
-    samples = sorted(slope_time(run, 5) for _ in range(3))
-    step_time = samples[len(samples) // 2]
+    step_time, samples = _median_slope(run)
     ips_samples = sorted(
         (per_chip_batch / s for s in samples), reverse=True
     )
 
     per_chip = per_chip_batch / step_time
-    # MFU against TPU v5e paper peak (197 bf16 TFLOP/s/chip).  Context:
-    # a plain big bf16 matmul slope-times to ~70 TFLOP/s through this
-    # chip's tunnel, so ~31% model-flops MFU here is ~88% of the chip's
-    # demonstrated sustained rate.
-    peak = 197e12
-    mfu = step_flops_per_dev / step_time / peak
+    # MFU against TPU v5e paper peak.  Context: the chip sustains
+    # ~191 TF/s on large bf16 matmuls through this tunnel, so ~31%
+    # model-flops MFU here is conv/XLA-bound, not tunnel-bound.
+    mfu = step_flops_per_dev / step_time / V5E_BF16_PEAK
     if loader is not None:
         # Stop the prefetch producer thread FIRST (its generator close
         # joins the thread — unbounded, see close_join_timeout above), so
@@ -233,27 +223,169 @@ def main(argv=None):
     metric = "images/sec/chip ResNet-50 ImageNet train step"
     if args.pipeline:
         metric += " (host pipeline)"
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(per_chip, 2),
-                "unit": "images/sec/chip",
-                "vs_baseline": round(per_chip / REFERENCE_IMAGES_PER_SEC_PER_CHIP, 3),
-                "mfu_vs_v5e_peak": round(mfu, 4),
-                "model_tflops_per_sec_per_chip": round(
-                    step_flops_per_dev / step_time / 1e12, 2
-                ),
-                "runs_img_per_sec": [round(v, 1) for v in ips_samples],
-                "spread_pct": round(
-                    100.0
-                    * (ips_samples[0] - ips_samples[-1])
-                    / ips_samples[-1],
-                    1,
-                ),
-            }
-        )
+    return {
+        "metric": metric,
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / REFERENCE_IMAGES_PER_SEC_PER_CHIP, 3),
+        "mfu_vs_v5e_peak": round(mfu, 4),
+        "model_tflops_per_sec_per_chip": round(
+            step_flops_per_dev / step_time / 1e12, 2
+        ),
+        "runs_img_per_sec": [round(v, 1) for v in ips_samples],
+        "spread_pct": round(
+            100.0 * (ips_samples[0] - ips_samples[-1]) / ips_samples[-1], 1
+        ),
+    }
+
+
+def bench_lm(comm, args):
+    """Decoder-only LM train step: flash attention + fused CE + remat,
+    AdamW, bf16 compute with fp32 params.  Per-chip batch x S tokens."""
+    from chainermn_tpu.models.transformer import TransformerLM
+    from chainermn_tpu.ops import make_flash_attention_fn
+    from chainermn_tpu.ops.fused_ce import fused_cross_entropy
+
+    n_dev = comm.device_size
+    B, S = args.lm_batch, args.lm_seq
+    cfg = dict(
+        vocab=args.lm_vocab, d_model=args.lm_d_model,
+        n_heads=args.lm_heads, d_ff=args.lm_d_ff,
+        n_layers=args.lm_layers, max_len=S,
     )
+    use_remat = not args.lm_no_remat
+    model = TransformerLM(
+        **cfg, remat=use_remat,
+        attention_fn=make_flash_attention_fn(causal=True),
+    )
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(
+        rng.randint(0, cfg["vocab"], size=(B * n_dev, S)), jnp.int32
+    )
+    labels = jnp.asarray(
+        rng.randint(0, cfg["vocab"], size=(B * n_dev, S)), jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(0), tokens[:1])["params"]
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+
+    opt = chainermn_tpu.create_multi_node_optimizer(
+        optax.adamw(3e-4, weight_decay=0.1), comm
+    )
+    state = opt.init(params)
+
+    def loss_fn(p, batch):
+        toks, labs = batch
+        h = model.apply({"params": p}, toks, return_hidden=True)
+        return fused_cross_entropy(
+            h, p["embed"]["embedding"], labs, chunk=args.lm_ce_chunk
+        )
+
+    step = opt.make_train_step(loss_fn, donate=True)
+
+    # MODEL FLOPs (the Megatron MFU convention — excludes remat
+    # recompute): 6 * n_params per token (2 fwd + 4 bwd) plus causal
+    # attention 6 * S * d per token per layer (QK^T + AV, halved by
+    # causality, backward 2x forward).
+    model_flops = B * S * (
+        6.0 * n_params
+        + 6.0 * S * cfg["d_model"] * cfg["n_layers"]
+    )
+    # EXECUTED FLOPs from XLA's cost model on the compiled step —
+    # includes the remat recompute, so it measures hardware utilization
+    # rather than model efficiency.
+    step_flops_per_dev = _compiled_flops_per_device(
+        step, params, state, (tokens, labels),
+        fallback=model_flops * (4.0 / 3.0 if use_remat else 1.0),
+    )
+
+    for _ in range(3):
+        params, state, loss = step(params, state, (tokens, labels))
+    sync(loss)
+
+    def run(n):
+        nonlocal params, state
+        t0 = time.perf_counter()
+        for _ in range(n):
+            params, state, loss = step(params, state, (tokens, labels))
+        sync(loss)
+        return time.perf_counter() - t0
+
+    step_time, samples = _median_slope(run)
+    tok_per_chip = B * S / step_time
+    mfu = model_flops / step_time / V5E_BF16_PEAK
+    hw_util = step_flops_per_dev / step_time / V5E_BF16_PEAK
+    return {
+        "metric": "tokens/sec/chip decoder-LM train step "
+                  "(flash attention + fused CE + remat, AdamW)",
+        "value": round(tok_per_chip, 1),
+        "unit": "tokens/sec/chip",
+        "mfu_vs_v5e_peak": round(mfu, 4),
+        "hw_flops_utilization": round(hw_util, 4),
+        "model_tflops_per_sec_per_chip": round(
+            model_flops / step_time / 1e12, 2
+        ),
+        "executed_tflops_per_sec_per_chip": round(
+            step_flops_per_dev / step_time / 1e12, 2
+        ),
+        "params_millions": round(n_params / 1e6, 1),
+        "config": {**cfg, "per_chip_batch": B, "remat": use_remat,
+                   "optimizer": "adamw"},
+        "runs_tok_per_sec": [
+            round(B * S / s, 1) for s in sorted(samples)
+        ],
+        "spread_pct": round(
+            100.0 * (max(samples) - min(samples)) / min(samples), 1
+        ),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", choices=["resnet", "lm"], default=None,
+                    help="run a single flagship (default: both)")
+    ap.add_argument(
+        "--pipeline", action="store_true",
+        help="feed the ResNet step through the real host input pipeline "
+             "(multiprocess shared-memory loader + prefetch) instead of a "
+             "resident batch",
+    )
+    ap.add_argument(
+        "--loader-workers", type=int, default=2,
+        help="worker processes for --pipeline batch assembly",
+    )
+    ap.add_argument(
+        "--per-chip-batch", type=int, default=256,
+        help="ResNet per-device batch (256 = measured optimum)",
+    )
+    ap.add_argument(
+        "--input-dtype", choices=["float32", "bfloat16"], default="float32",
+        help="dtype of the fed ResNet batch (model casts to bf16 "
+             "internally either way)",
+    )
+    ap.add_argument("--lm-batch", type=int, default=8,
+                    help="LM per-device batch (sequences)")
+    ap.add_argument("--lm-seq", type=int, default=4096)
+    ap.add_argument("--lm-vocab", type=int, default=32768)
+    ap.add_argument("--lm-d-model", type=int, default=2048)
+    ap.add_argument("--lm-heads", type=int, default=16)
+    ap.add_argument("--lm-d-ff", type=int, default=8192)
+    ap.add_argument("--lm-layers", type=int, default=8)
+    ap.add_argument("--lm-ce-chunk", type=int, default=1024)
+    ap.add_argument("--lm-no-remat", action="store_true",
+                    help="disable per-layer remat (more activation "
+                         "memory, no recompute FLOPs)")
+    args = ap.parse_args(argv)
+    comm = chainermn_tpu.create_communicator("xla_ici")
+
+    if args.only == "lm":
+        out = bench_lm(comm, args)
+    elif args.only == "resnet":
+        out = bench_resnet(comm, args)
+    else:
+        out = bench_resnet(comm, args)
+        out["lm"] = bench_lm(comm, args)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

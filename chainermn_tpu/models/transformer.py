@@ -38,16 +38,23 @@ class MultiHeadAttention(nn.Module):
     attention_fn: Optional[Callable] = None  # pluggable (ring/ulysses SP)
     decode: bool = False        # incremental decoding with a KV cache
     cache_len: int = 0          # cache capacity (max sequence length)
+    n_kv_heads: Optional[int] = None  # GQA/MQA: fewer K/V heads (divides
+                                      # n_heads; None = MHA)
 
     @nn.compact
     def __call__(self, q_in, kv_in, mask=None):
         d_head = self.d_model // self.n_heads
-        dense = lambda name: nn.DenseGeneral(  # noqa: E731
-            (self.n_heads, d_head), dtype=self.dtype, name=name, use_bias=False
+        n_kv = self.n_kv_heads or self.n_heads
+        if self.n_heads % n_kv:
+            raise ValueError(
+                f"n_kv_heads ({n_kv}) must divide n_heads ({self.n_heads})"
+            )
+        dense = lambda name, h: nn.DenseGeneral(  # noqa: E731
+            (h, d_head), dtype=self.dtype, name=name, use_bias=False
         )
-        q = dense("query")(q_in)
-        k = dense("key")(kv_in)
-        v = dense("value")(kv_in)
+        q = dense("query", self.n_heads)(q_in)
+        k = dense("key", n_kv)(kv_in)
+        v = dense("value", n_kv)(kv_in)
 
         if self.decode:
             # KV cache (flax "cache" collection): one new token per call is
@@ -75,12 +82,12 @@ class MultiHeadAttention(nn.Module):
             B = q.shape[0]
             ck = self.variable(
                 "cache", "cached_key",
-                lambda: jnp.zeros((B, self.cache_len, self.n_heads, d_head),
+                lambda: jnp.zeros((B, self.cache_len, n_kv, d_head),
                                   k.dtype),
             )
             cv = self.variable(
                 "cache", "cached_value",
-                lambda: jnp.zeros((B, self.cache_len, self.n_heads, d_head),
+                lambda: jnp.zeros((B, self.cache_len, n_kv, d_head),
                                   v.dtype),
             )
             cidx = self.variable(
@@ -96,8 +103,15 @@ class MultiHeadAttention(nn.Module):
             mask = (jnp.arange(self.cache_len) <= i)[None, None, None, :]
 
         if self.attention_fn is not None:
+            # GQA-aware adapters (flash and its SP compositions) consume
+            # the reduced kv head count directly.
             out = self.attention_fn(q, k, v, mask)
         else:
+            if n_kv != self.n_heads:
+                # Dense-softmax path: broadcast kv heads (the grads sum
+                # back over the group through repeat's transpose).
+                k = jnp.repeat(k, self.n_heads // n_kv, axis=2)
+                v = jnp.repeat(v, self.n_heads // n_kv, axis=2)
             scale = 1.0 / np.sqrt(d_head)
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
             if mask is not None:
@@ -129,6 +143,7 @@ class EncoderLayer(nn.Module):
     attention_fn: Optional[Callable] = None
     decode: bool = False
     cache_len: int = 0
+    n_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -136,6 +151,7 @@ class EncoderLayer(nn.Module):
         x = x + MultiHeadAttention(
             self.d_model, self.n_heads, self.dtype, self.attention_fn,
             decode=self.decode, cache_len=self.cache_len,
+            n_kv_heads=self.n_kv_heads,
         )(h, h, mask)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         return x + FeedForward(self.d_model, self.d_ff, self.dtype)(h)
@@ -218,15 +234,27 @@ class TransformerLM(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[Callable] = None
     decode: bool = False        # KV-cache incremental decoding (generate())
+    remat: bool = False         # rematerialize each layer in backward
+    n_kv_heads: Optional[int] = None  # GQA/MQA (divides n_heads)
 
     @nn.compact
-    def __call__(self, tokens, position_offset=None):
+    def __call__(self, tokens, position_offset=None, return_hidden=False):
         """``position_offset``: global position of this shard's first token —
         pass ``axis_index * S_local`` when the sequence dimension is sharded
         (sequence parallelism); requires a sequence-aware ``attention_fn``
         (ring/Ulysses), since the dense path's causal mask is local.
         Alternatively a ``(S_local,)`` int array of explicit global
-        positions, for non-contiguous shard layouts (zigzag ring)."""
+        positions, for non-contiguous shard layouts (zigzag ring).
+
+        ``return_hidden=True`` returns the final-norm hidden states
+        ``(B, S, d_model)`` instead of logits — the input for
+        :func:`chainermn_tpu.ops.fused_cross_entropy`, which never
+        materializes the ``(B*S, vocab)`` logits the default
+        ``embed.attend`` path does.
+
+        ``remat=True`` wraps every layer in ``jax.checkpoint``: backward
+        recomputes layer activations instead of storing ~6 per-layer
+        tensors — the standard long-context memory/FLOP trade."""
         import jax.lax as _lax
 
         embed = nn.Embed(self.vocab, self.d_model, dtype=self.dtype, name="embed")
@@ -239,14 +267,25 @@ class TransformerLM(nn.Module):
         else:
             pos = _lax.dynamic_slice_in_dim(pe, position_offset, S, axis=0)
         x = embed(tokens) + pos[None].astype(self.dtype)
-        mask = causal_mask(S)
+        # Pluggable attention (flash/ring/ulysses) imposes its own
+        # causality and ignores the mask argument — skip materializing
+        # the (S, S) mask, which at long context is the largest host
+        # constant in the program (S=16k: 256 MiB as bool).
+        mask = None if self.attention_fn is not None else causal_mask(S)
+        layer_cls = (
+            nn.remat(EncoderLayer, static_argnums=())
+            if self.remat else EncoderLayer
+        )
         for i in range(self.n_layers):
-            x = EncoderLayer(
+            x = layer_cls(
                 self.d_model, self.n_heads, self.d_ff, self.dtype,
                 self.attention_fn, name=f"layer_{i}",
                 decode=self.decode, cache_len=self.max_len if self.decode else 0,
+                n_kv_heads=self.n_kv_heads,
             )(x, mask)
         x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
+        if return_hidden:
+            return x
         return embed.attend(x.astype(jnp.float32))
 
 

@@ -9,3 +9,7 @@ from chainermn_tpu.ops.flash_attention import (  # noqa: F401
     flash_attention,
     make_flash_attention_fn,
 )
+from chainermn_tpu.ops.fused_ce import (  # noqa: F401
+    fused_cross_entropy,
+    fused_cross_entropy_with_lse,
+)

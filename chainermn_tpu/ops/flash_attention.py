@@ -136,14 +136,35 @@ def _attn_kernel(
         lse_ref[0] = (m_ref[:, 0] + jnp.log(denom))[:, None]
 
 
+def _kv_group(BHq: int, BHk: int) -> int:
+    """Query-heads-per-KV-head group size, derived purely from the leading
+    (batch*heads) dims — GQA/MQA need no extra static arguments.
+
+    Layout contract: the (B, S, H, D) -> (B*H, S, D) flattening is
+    batch-major with query head ``h = hk * G + g`` (the natural
+    ``transpose(0,2,1,3).reshape`` order), so q row ``b``'s KV row is
+    exactly ``b // G``."""
+    if BHq % BHk:
+        raise ValueError(
+            f"query head rows {BHq} not a multiple of kv head rows {BHk}"
+        )
+    return BHq // BHk
+
+
 def _flash_bh_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret,
                   q_seg=None, kv_seg=None):
     """(BH, S, D) flash attention forward; returns (o, lse).
+
+    ``k``/``v`` may carry FEWER head rows than ``q`` (GQA/MQA): with
+    ``G = BHq // BHk``, q row ``b`` attends to kv row ``b // G`` — pure
+    index-map arithmetic, the shared KV block is streamed once per query
+    head with no materialized repeat.
 
     ``q_seg``/``kv_seg``: optional (BH, S, 1) int32 segment ids for packed
     sequences — attention is masked to segment-id equality."""
     BH, Sq, D = q.shape
     Sk = k.shape[1]
+    G = _kv_group(BH, k.shape[0])
     grid = (BH, Sq // block_q, Sk // block_k)
     segmented = q_seg is not None
 
@@ -158,14 +179,14 @@ def _flash_bh_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret,
     ]
     in_specs = [
         pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b // G, j, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b // G, j, 0)),
     ]
     args = [q, k, v]
     if segmented:
         in_specs += [
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, 1), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, 1), lambda b, i, j: (b // G, j, 0)),
         ]
         args += [q_seg, kv_seg]
     return pl.pallas_call(
@@ -237,6 +258,7 @@ def _dq_kernel(
 def _dkv_kernel(
     *refs,
     scale: float, causal: bool, segmented: bool, block_q: int, block_k: int,
+    n_q: int,
 ):
     if segmented:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
@@ -245,11 +267,15 @@ def _dkv_kernel(
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          dk_ref, dv_ref, dk_acc, dv_acc) = refs
         qs_ref = ks_ref = None
-    ik = pl.program_id(1)   # grid: (BH, n_k, n_q) — q innermost
-    iq = pl.program_id(2)
-    n_q = pl.num_programs(2)
+    ik = pl.program_id(1)   # grid: (BHk, n_k, G*n_q) — (head, q) innermost
+    # The innermost axis enumerates (g, iq) pairs: for GQA every query
+    # head of the group contributes to this KV row's dk/dv, so the
+    # accumulator runs over all G * n_q steps and flushes once.
+    i = pl.program_id(2)
+    iq = i % n_q
+    n_i = pl.num_programs(2)
 
-    @pl.when(iq == 0)
+    @pl.when(i == 0)
     def _():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -280,7 +306,7 @@ def _dkv_kernel(
         ds = (p * (dp - delta_ref[0, :, :]) * scale).astype(q.dtype)
         dk_acc[:] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
 
-    @pl.when(iq == n_q - 1)
+    @pl.when(i == n_i - 1)
     def _():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -297,6 +323,8 @@ def _flash_bh_bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
     """
     BH, Sq, D = q.shape
     Sk = k.shape[1]
+    BHk = k.shape[0]
+    G = _kv_group(BH, BHk)
     segmented = q_seg is not None
     # delta_i = rowsum(dO ∘ O) — cheap elementwise, XLA handles it.
     delta = jnp.sum(
@@ -306,14 +334,14 @@ def _flash_bh_bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
         delta = delta - dlse.astype(jnp.float32)[..., None]
 
     q_spec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
-    k_spec = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
+    k_spec = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b // G, j, 0))
     r_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
     dq_in = [q_spec, k_spec, k_spec, q_spec, r_spec, r_spec]
     dq_args = [q, k, v, do, lse, delta]
     if segmented:
         dq_in += [
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, 1), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, 1), lambda b, i, j: (b // G, j, 0)),
         ]
         dq_args += [q_seg, kv_seg]
     dq = pl.pallas_call(
@@ -329,28 +357,40 @@ def _flash_bh_bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
         interpret=interpret,
     )(*dq_args)
 
-    # dkv grid transposes the block walk: (BH, n_k, n_q), q innermost.
-    qT_spec = pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0))
+    # dkv grid walks (BHk, n_k, G*n_q): one program chain per KV row with
+    # every query head of its group innermost — the group's contributions
+    # accumulate in the scratch and flush once, so GQA's dk/dv reduction
+    # needs no extra pass.  Query-side rows for (kv row b, inner step i)
+    # live at q row b*G + i // n_q, q block i % n_q.
+    n_q = Sq // block_q
+    qT_spec = pl.BlockSpec(
+        (1, block_q, D), lambda b, j, i: (b * G + i // n_q, i % n_q, 0)
+    )
     kT_spec = pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0))
-    rT_spec = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
+    rT_spec = pl.BlockSpec(
+        (1, block_q, 1), lambda b, j, i: (b * G + i // n_q, i % n_q, 0)
+    )
     dkv_in = [qT_spec, kT_spec, kT_spec, qT_spec, rT_spec, rT_spec]
     dkv_args = [q, k, v, do, lse, delta]
     if segmented:
         dkv_in += [
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec(
+                (1, block_q, 1),
+                lambda b, j, i: (b * G + i // n_q, i % n_q, 0),
+            ),
             pl.BlockSpec((1, block_k, 1), lambda b, j, i: (b, j, 0)),
         ]
         dkv_args += [q_seg, kv_seg]
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal, segmented=segmented,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, n_q=n_q,
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, Sk, D), v.dtype),
+            jax.ShapeDtypeStruct((BHk, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((BHk, Sk, D), v.dtype),
         ],
-        grid=(BH, Sk // block_k, Sq // block_q),
+        grid=(BHk, Sk // block_k, G * n_q),
         in_specs=dkv_in,
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
@@ -527,6 +567,13 @@ flash_attention_with_lse_seg.defvjp(
 
 def _xla_attention(q, k, v, scale, causal, q_segment_ids=None,
                    kv_segment_ids=None):
+    if k.shape[2] != q.shape[2]:
+        # GQA/MQA fallback: broadcast KV heads to the query head count.
+        # jnp.repeat's transpose sums the group's dk/dv — exactly the
+        # grouped reduction the Pallas dkv kernel does in its scratch.
+        G = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, G, axis=2)
+        v = jnp.repeat(v, G, axis=2)
     logits = jnp.einsum(
         "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
     ) * scale
@@ -570,6 +617,12 @@ def flash_attention(
     verified on a v5e-class chip against the XLA oracle at D ∈ {16..128}
     and at the wide-head points D ∈ {160, 192, 256}).
 
+    GQA/MQA: ``k``/``v`` may carry ``H_kv`` heads with ``H_kv`` dividing
+    ``H`` (``H_kv == 1`` is MQA).  Query head ``h`` attends to kv head
+    ``h // (H / H_kv)``; the kernels stream the SHARED kv block via index
+    maps (no materialized repeat) and reduce the group's dk/dv inside the
+    backward kernel's accumulator.
+
     ``q_segment_ids``/``kv_segment_ids``: optional (B, S) int32 segment
     ids for PACKED sequences — tokens attend only within their own
     segment (combined with the causal mask), the packed-long-context
@@ -585,6 +638,12 @@ def flash_attention(
     """
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
+    Hk = k.shape[2]
+    if H % Hk or v.shape[2] != Hk:
+        raise ValueError(
+            f"kv heads ({Hk}, v {v.shape[2]}) must be equal and divide "
+            f"the query head count ({H})"
+        )
     if scale is None:
         scale = 1.0 / (D**0.5)
     if (q_segment_ids is None) != (kv_segment_ids is None):
@@ -638,13 +697,15 @@ def flash_attention(
             q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
         )
 
-    # (B, S, H, D) → (B*H, S, D)
+    # (B, S, H, D) → (B*H, S, D); kv keep their own (possibly smaller)
+    # head count — the batch-major flattening makes q row b's kv row
+    # exactly b // (H // Hk) (see _kv_group).
     qt = q.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)
-    kt = k.transpose(0, 2, 1, 3).reshape(B * H, Sk, D)
-    vt = v.transpose(0, 2, 1, 3).reshape(B * H, Sk, D)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * Hk, Sk, D)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * Hk, Sk, D)
     if q_segment_ids is not None:
         qs = seg_to_bh(q_segment_ids, H)
-        ks = seg_to_bh(kv_segment_ids, H)
+        ks = seg_to_bh(kv_segment_ids, Hk)
         out = _flash_bh_seg(
             qt, kt, vt, qs, ks, scale, causal, block_q, block_k, interpret
         )

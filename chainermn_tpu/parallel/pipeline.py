@@ -289,20 +289,20 @@ def pipeline_interleaved_1f1b_loss_and_grads(
     ``~(v+1)/2v`` — a factor approaching 2 at large ``v``, NOT the
     ``1/v`` of Megatron-LM's tighter schedule.
 
-    That residual gap is structural, not sloppiness: within this
+    That residual gap is structural to the COUPLED design: within this
     schedule each device's forward slot stream is GAPLESS over
     ``[idx, Mv + idx)`` and its backward slot stream is gapless over
     ``[2(L-1) - idx, ...)``; the whole bubble is the dependency-forced
     phase offset between the two streams (microbatch 0's stage-0
-    backward cannot fire before tick ``2(L-1)``), which a lockstep
-    one-``ppermute``-stream SPMD program cannot compress — every arrival
-    must be served the tick it lands, so admissions cannot be deferred
-    into it.  Megatron's schedule beats it only by buffering in-flight
-    activations and reordering per-device work (MIMD-style), which in
-    SPMD means carrying an explicit multi-slot arrival queue with
-    data-dependent selection (MaxText's ``circ_storage``) — a trade of
-    considerable program complexity and extra live activations for the
-    last ``~n(v-1)`` ticks of bubble.
+    backward cannot fire before tick ``2(L-1)``), which a
+    fwd+bwd-in-one-tick SPMD program cannot compress — every arrival
+    must be served the tick it lands.  DECOUPLING the directions removes
+    it: :func:`pipeline_circular_1f1b_loss_and_grads` runs the forward
+    as its own ``M*v + n - 1``-tick circular scan and lets AD mirror it
+    backward, reaching the Megatron bound ``(n-1)/(v*M)`` — at ``O(M*v)``
+    saved activations where this scheduler holds ``O(2L-1)``.  Keep this
+    one when the activation footprint binds; use the circular one when
+    the bubble does.
 
     Memory: the saved-input ring holds ``2L - 1`` microbatch activations
     (each chunk's backward recomputes only ITS chunk) versus ``2n - 1``
@@ -461,6 +461,187 @@ def pipeline_interleaved_1f1b_loss_and_grads(
             (m // n) * v * n + (m % n) + 2 * (L - 1) for m in range(M)
         ])
         out = out + (gx_ys[ticks].reshape(B, *x.shape[1:]),)
+    return out
+
+
+def circular_schedule_ticks(n: int, n_microbatches: int, n_chunks: int) -> int:
+    """Total forward ticks of the circular (buffered-admission) schedule:
+    ``M*v + n - 1`` — each device is gapless for its ``M*v`` chunk units,
+    offset by its ring position.  The backward (AD mirror) adds the same,
+    so the whole step's bubble is ``2(n-1)`` chunk-times against an ideal
+    ``2Mv`` — the Megatron-LM interleaved bound ``(n-1)/(v*M)``."""
+    return n_microbatches * n_chunks + n - 1
+
+
+def spmd_pipeline_circular(
+    stage_fn: Callable,
+    stage_params,
+    x,
+    axis_name: str,
+    n_microbatches: int,
+    n_chunks: int,
+):
+    """Circular (virtual-stage) pipeline FORWARD with round-buffered
+    admissions — the Megatron-tight interleaved schedule.
+
+    Device ``d`` holds ``v = n_chunks`` model chunks (global stage
+    ``s = l*n + d``; ``stage_params`` leads with the ``(v, ...)`` chunk
+    axis).  Microbatches are admitted in rounds of ``n`` and each round is
+    pushed through ALL ``v`` laps before the next round is admitted:
+    device ``d`` at tick ``t`` works local time ``u = t - d`` with
+
+        r = u // (n*v)   (admission round)
+        l = (u % (n*v)) // n   (chunk / lap)
+        m = r*n + u % n        (microbatch)
+
+    Every device's work stream is gapless over ``[d, d + M*v)`` and every
+    handoff lands exactly one tick before its consumption — including the
+    ring wrap ``n-1 → 0`` between laps — so the single ``ppermute`` shift
+    register IS the arrival buffer (the role MaxText's ``circ_storage``
+    plays for its all-at-once admission order; round admission makes the
+    buffer depth exactly 1).  Total ticks :func:`circular_schedule_ticks`
+    = ``M*v + n - 1``: bubble ``n - 1`` chunk-times forward.
+
+    Backward is jax AD through the scan (each tick ``jax.checkpoint``-ed:
+    backward recomputes the chunk forward from its saved input).  The
+    reverse scan mirrors the schedule tick for tick, so the combined
+    bubble is ``2(n-1)`` chunk-times against an ideal ``2*M*v`` — the
+    Megatron-LM interleaved bound ``(n-1)/(v*M)``, v times tighter than
+    :func:`pipeline_interleaved_1f1b_loss_and_grads`'s coupled-wavefront
+    ``~n(v+1)``.  The price is memory: AD saves one in-flight activation
+    per tick, ``O(M*v)`` microbatch activations, versus the coupled
+    scheduler's ``O(2nv - 1)`` ring — choose by whether the bubble or the
+    activation footprint binds.
+
+    Returns ``(B, ...)`` final-stage outputs in microbatch order, valid on
+    the LAST device (zeros elsewhere).
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    M = n_microbatches
+    v = n_chunks
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by n_microbatches {M}")
+    if M % n:
+        raise ValueError(
+            f"circular schedule needs n_microbatches ({M}) divisible by "
+            f"the pipeline size ({n}) — admissions happen in rounds"
+        )
+    if v < 1:
+        raise ValueError(f"n_chunks must be >= 1, got {v}")
+    mb = B // M
+    micro = x.reshape(M, mb, *x.shape[1:])
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    T = circular_schedule_ticks(n, M, v)
+
+    def tick(shift, t):
+        u = t - idx
+        r = u // (n * v)
+        q = u % (n * v)
+        l = q // n
+        m = r * n + q % n
+        active = jnp.logical_and(u >= 0, u < M * v)
+        feed = lax.dynamic_index_in_dim(
+            micro, jnp.clip(m, 0, M - 1), keepdims=False
+        )
+        xin = jnp.where(
+            jnp.logical_and(idx == 0, l == 0), feed, shift
+        )
+        p = jax.tree.map(
+            lambda pp: lax.dynamic_index_in_dim(
+                pp, jnp.clip(l, 0, v - 1), keepdims=False
+            ),
+            stage_params,
+        )
+        y = stage_fn(p, xin)
+        out = jnp.where(
+            jnp.logical_and(
+                active, jnp.logical_and(idx == n - 1, l == v - 1)
+            ),
+            y, jnp.zeros_like(y),
+        )
+        return lax.ppermute(y, axis_name, perm), out
+
+    _, ys = lax.scan(
+        jax.checkpoint(tick), jnp.zeros_like(micro[0]), jnp.arange(T)
+    )
+    # Microbatch m = r*n + j exits the last global stage (device n-1,
+    # lap v-1) at tick (n-1) + r*n*v + (v-1)*n + j.
+    import numpy as _np
+
+    exit_ticks = _np.array([
+        (n - 1) + (m // n) * n * v + (v - 1) * n + (m % n) for m in range(M)
+    ])
+    return ys[exit_ticks].reshape(B, *x.shape[1:])
+
+
+def pipeline_circular_1f1b_loss_and_grads(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    stage_params,
+    x,
+    target,
+    axis_name: str,
+    n_microbatches: int,
+    n_chunks: int,
+    loss_params=None,
+    with_input_grads: bool = False,
+):
+    """Loss + grads over :func:`spmd_pipeline_circular` — the
+    Megatron-tight interleaved schedule with the same return contract as
+    :func:`pipeline_interleaved_1f1b_loss_and_grads` (``stage_grads``
+    carries the ``(v, ...)`` chunk axis; head grads live on the last
+    stage, input cotangents on stage 0 — psum both before use).
+
+    The backward here is jax AD through the circular scan (mirrored
+    schedule, per-tick remat), not an explicit-vjp wavefront: bubble
+    ``(n-1)/(v*M)`` at ``O(M*v)`` saved activations.  Use the coupled
+    explicit-vjp scheduler when the activation footprint binds instead.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    M = n_microbatches
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by n_microbatches {M}")
+    mb = B // M
+    tmicro = target.reshape(M, mb, *target.shape[1:])
+
+    def local_loss(sp, lp, xx):
+        # Device-LOCAL masked loss — deliberately not psum'd: seeding
+        # every device's local output with cotangent 1 differentiates
+        # their sum (= the last device's loss, others are hard zeros),
+        # with cotangents routed by the transposed ppermutes.  A psum
+        # here would transpose to another psum under AD (replication
+        # tracking is off inside these schedules), inflating every
+        # gradient by the axis size.
+        outs = spmd_pipeline_circular(
+            stage_fn, sp, xx, axis_name, M, n_chunks
+        )
+        om = outs.reshape(M, mb, *outs.shape[1:])
+        if lp is None:
+            per = jax.vmap(loss_fn)(om, tmicro)
+        else:
+            per = jax.vmap(loss_fn, in_axes=(None, 0, 0))(lp, om, tmicro)
+        return jnp.where(idx == n - 1, per.mean(), 0.0)
+
+    if loss_params is None:
+        argnums = (0, 2) if with_input_grads else (0,)
+        local, grads = jax.value_and_grad(local_loss, argnums=argnums)(
+            stage_params, None, x
+        )
+        out = (lax.psum(local, axis_name), grads[0])
+        if with_input_grads:
+            out = out + (grads[1],)
+        return out
+    argnums = (0, 1, 2) if with_input_grads else (0, 1)
+    local, grads = jax.value_and_grad(local_loss, argnums=argnums)(
+        stage_params, loss_params, x
+    )
+    out = (lax.psum(local, axis_name), grads[0], grads[1])
+    if with_input_grads:
+        out = out + (grads[2],)
     return out
 
 

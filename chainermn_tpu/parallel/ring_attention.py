@@ -33,8 +33,40 @@ from jax import lax
 def _block_attn(q, k, v, mask, scale):
     """One q-block × kv-block attention with unnormalized accumulators.
 
-    q: (B, Sq, H, D); k/v: (B, Sk, H, D); mask: broadcastable to
-    (B, H, Sq, Sk) boolean. Returns (scores_max, exp_sums, weighted_v)."""
+    q: (B, Sq, H, D); k/v: (B, Sk, Hk, D) with ``Hk`` dividing ``H``
+    (GQA/MQA: the group's query heads share one kv head — grouped einsum,
+    no materialized repeat, so the ring rotates only the REDUCED kv
+    blocks); mask: broadcastable to (B, H, Sq, Sk) boolean with a size-1
+    head axis.  Returns (scores_max, exp_sums, weighted_v) shaped with
+    the full ``H``."""
+    B, Sq, H, D = q.shape
+    Hk = k.shape[2]
+    if Hk != H:
+        if H % Hk:
+            raise ValueError(
+                f"kv heads ({Hk}) must divide query heads ({H})"
+            )
+        G = H // Hk
+        qg = q.reshape(B, Sq, Hk, G, D)
+        logits = jnp.einsum(
+            "bqhgd,bkhd->bhgqk",
+            qg.astype(jnp.float32), k.astype(jnp.float32),
+        ) * scale
+        if mask is not None:
+            # Callers build masks with a size-1 head axis; add a size-1
+            # group axis so it broadcasts over (Hk, G).
+            logits = jnp.where(mask[:, :, None], logits, -jnp.inf)
+        m = jnp.max(logits, axis=-1)
+        safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+        p = jnp.exp(logits - safe_m[..., None])
+        p = jnp.where(jnp.isfinite(logits), p, 0.0)
+        l = jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhgqk,bkhd->bqhgd", p, v.astype(jnp.float32))
+        return (
+            m.reshape(B, H, Sq),
+            l.reshape(B, H, Sq),
+            pv.reshape(B, Sq, H, D),
+        )
     logits = jnp.einsum(
         "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
     ) * scale
@@ -89,7 +121,10 @@ def ring_attention(
     """Sequence-parallel attention; call inside ``shard_map`` with the
     sequence dimension sharded over ``axis_name``.
 
-    q, k, v: (B, S_local, H, D) — this chip's sequence shard.
+    q, k, v: (B, S_local, H, D) — this chip's sequence shard.  GQA/MQA:
+    k/v may carry fewer heads (dividing H) — only the REDUCED kv blocks
+    rotate around the ring, so sequence-parallel wire drops by the group
+    factor, GQA's whole point at long context.
     ``q_segment_ids``/``kv_segment_ids``: optional (B, S_local) int32
     LOCAL shards of packed-sequence segment ids — the KV ids rotate
     around the ring with their K/V blocks, so attention never crosses a
@@ -214,6 +249,7 @@ def _flash_block_stats(q, k, v, causal, scale, block, interpret,
     )
 
     B, S, H, D = q.shape
+    Hk = k.shape[2]   # GQA: the kernel groups q rows onto kv rows itself
     if qseg is None:
         o, lse = flash_attention_with_lse(
             to_bh(q), to_bh(k), to_bh(v), scale, causal, block, block,
@@ -222,7 +258,7 @@ def _flash_block_stats(q, k, v, causal, scale, block, interpret,
     else:
         o, lse = flash_attention_with_lse_seg(
             to_bh(q), to_bh(k), to_bh(v),
-            seg_to_bh(qseg, H), seg_to_bh(kseg, H),
+            seg_to_bh(qseg, H), seg_to_bh(kseg, Hk),
             scale, causal, block, block, interpret,
         )
     o4 = from_bh(o, B, H).astype(jnp.float32)

@@ -46,8 +46,18 @@ def ulysses_attention(
     """
     n = lax.axis_size(axis_name)
     B, S_loc, H, D = q.shape
+    Hk = k.shape[2]
     if H % n:
         raise ValueError(f"head count {H} not divisible by axis size {n}")
+    if Hk != H and (H % Hk or Hk % n):
+        # GQA: kv heads must divide the query heads AND the axis size —
+        # the head all-to-all deals kv heads across chips too, after
+        # which the shared flash kernel regroups (H/n)/(Hk/n) = G
+        # query heads per kv head locally.
+        raise ValueError(
+            f"kv head count {Hk} must divide query heads {H} and be "
+            f"divisible by axis size {n}"
+        )
     if scale is None:
         scale = 1.0 / (D**0.5)
     if kv_segment_ids is not None and q_segment_ids is None:

@@ -54,6 +54,12 @@ def main(argv=None):
     p.add_argument("--batchsize", type=int, default=8, help="global batch")
     p.add_argument("--d-model", type=int, default=256)
     p.add_argument("--n-heads", type=int, default=8)
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="GQA/MQA: K/V head count (divides --n-heads; "
+                        "1 = MQA; default = MHA).  The flash kernel and "
+                        "all --sp modes consume the reduced heads "
+                        "natively — ring/zigzag rotate only the reduced "
+                        "KV blocks")
     p.add_argument("--d-ff", type=int, default=1024)
     p.add_argument("--layers", type=int, default=4)
     p.add_argument("--vocab", type=int, default=512)
@@ -148,11 +154,16 @@ def main(argv=None):
         # Only ulysses reshapes heads across the axis; ring/zigzag shard
         # the sequence and accept any head count.
         raise SystemExit("--sp ulysses needs n_heads % sp ways == 0")
+    if args.kv_heads is not None:
+        if args.n_heads % args.kv_heads:
+            raise SystemExit("--kv-heads must divide --n-heads")
+        if args.sp == "ulysses" and args.kv_heads % sp_ways:
+            raise SystemExit("--sp ulysses needs kv_heads % sp ways == 0")
 
     model = TransformerLM(
         vocab=vocab, d_model=args.d_model, n_heads=args.n_heads,
         d_ff=args.d_ff, n_layers=args.layers, max_len=S, dtype=dtype,
-        attention_fn=attention_fn,
+        attention_fn=attention_fn, n_kv_heads=args.kv_heads,
     )
     S_local = S // max(sp_ways_eff, 1)
     tok0 = jnp.zeros((1, S_local), jnp.int32)
@@ -161,7 +172,7 @@ def main(argv=None):
     init_model = TransformerLM(
         vocab=vocab, d_model=args.d_model, n_heads=args.n_heads,
         d_ff=args.d_ff, n_layers=args.layers, max_len=S, dtype=dtype,
-        attention_fn=None,
+        attention_fn=None, n_kv_heads=args.kv_heads,
     )
     params = init_model.init(jax.random.PRNGKey(0), tok0)
     params = jax.tree.map(lambda x: x.astype(jnp.float32), params)

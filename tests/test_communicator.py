@@ -656,3 +656,66 @@ def test_ppermute_general_fallback_warns_once(devices8):
         assert not [w for w in rec3 if "all_gather" in str(w.message)]
     finally:
         comm_base._PPERMUTE_FALLBACK_WARNED = True
+
+
+@pytest.mark.parametrize("world", [3, 5, 6, 7])
+def test_gather_scatter_non_power_of_two_worlds(devices8, world):
+    """VERDICT r4 item 4: the binomial gather/scatter padding paths
+    (trailing senders ship padding rows when the world is not a power of
+    two) must stay value-exact on 3/5/6/7-device worlds — sizes the
+    8-device dryruns never see."""
+    mesh = build_mesh(inter_size=1, intra_size=world,
+                      devices=devices8[:world])
+    comm = create_communicator("naive", mesh=mesh)
+    n = comm.device_size
+    assert n == world
+
+    # gather to every possible root
+    for root in (0, n - 1, n // 2):
+        def gbody(xs):
+            return comm.gather(xs[0], root=root)[None]
+
+        out = np.asarray(jax.jit(comm.shard_map(
+            gbody, in_specs=(comm._world_spec,),
+            out_specs=comm._world_spec,
+        ))(jnp.arange(1.0, n + 1.0)))
+        np.testing.assert_allclose(out[root], np.arange(1.0, n + 1.0))
+        for r in range(n):
+            if r != root:
+                np.testing.assert_allclose(out[r], np.zeros(n))
+
+    # scatter from root 0: device r gets its own 2-chunk
+    data = jnp.arange(float(n * 2))
+
+    def sbody(xs):
+        chunk = comm.scatter(
+            jnp.where(comm.axis_index() == 0, xs, 0.0), root=0
+        )
+        return chunk[None]
+
+    out = np.asarray(jax.jit(comm.shard_map(
+        sbody, in_specs=(P(),), out_specs=comm._world_spec,
+    ))(data))
+    for r in range(n):
+        np.testing.assert_allclose(out[r].ravel(), [2 * r, 2 * r + 1])
+
+    # gather gradient: transpose of the padded tree must still route each
+    # source exactly its slot's cotangent.
+    weights = jnp.arange(1.0, n + 1.0)
+    from jax import lax as _lax
+
+    def loss(data):
+        def body(xs):
+            g = comm.gather(xs[0], root=0)
+            contrib = jnp.where(
+                comm.axis_index() == 0, jnp.sum(g * weights), 0.0
+            )
+            return _lax.psum(contrib, comm.axes)[None]
+
+        y = comm.shard_map(
+            body, in_specs=(comm._world_spec,), out_specs=comm._world_spec
+        )(data)
+        return y[0]
+
+    g = np.asarray(jax.jit(jax.grad(loss))(jnp.zeros(n)))
+    np.testing.assert_allclose(g, np.asarray(weights))

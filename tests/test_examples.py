@@ -117,6 +117,25 @@ def test_long_context_lm_smoke(sp):
 
 @pytest.mark.slow
 @pytest.mark.parametrize("sp", ["none", "ring", "zigzag", "ulysses"])
+def test_long_context_gqa_smoke(sp):
+    """GQA (--kv-heads 2 of 4) through every attention backend: the
+    reduced KV heads ride the flash kernel, the ring rotation, and the
+    ulysses head all-to-all (which deals kv heads across chips, so its
+    leg runs sp ways = 2 = kv heads)."""
+    extra = [] if sp == "none" else (
+        ["--dp", "4"] if sp == "ulysses" else ["--dp", "2"]
+    )
+    _run(
+        "long_context/train_lm.py",
+        "--sp", sp, "--seq-len", "256", "--batchsize", "8",
+        "--d-model", "32", "--n-heads", "4", "--kv-heads", "2",
+        "--d-ff", "64", "--layers", "1", "--vocab", "64", "--epochs", "1",
+        "--steps-per-epoch", "4", "--dtype", "float32", *extra,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("sp", ["none", "ring", "zigzag", "ulysses"])
 def test_long_context_packed_smoke(sp):
     """Packed-sequence training through EVERY attention backend: segment
     masks in the flash kernel (none), rotating KV ids (ring/zigzag), and

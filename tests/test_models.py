@@ -2,6 +2,7 @@
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from chainermn_tpu.models import MLP
@@ -158,3 +159,70 @@ def test_kv_cache_rejects_multi_token_chunk():
     )
     with pytest.raises(ValueError, match="one token per call"):
         lm.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+
+
+def test_transformer_lm_hidden_plus_fused_ce_matches_logit_loss():
+    """return_hidden + fused_cross_entropy is the memory-lean spelling of
+    the default logits + softmax-CE path — same loss, same grads."""
+    import optax
+    from chainermn_tpu.models.transformer import TransformerLM
+    from chainermn_tpu.ops.fused_ce import fused_cross_entropy
+
+    lm = TransformerLM(vocab=64, d_model=32, n_heads=4, d_ff=64,
+                       n_layers=2, max_len=16)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 64, size=(2, 16)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, 64, size=(2, 16)), jnp.int32)
+    params = lm.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    def loss_logits(p):
+        logits = lm.apply({"params": p}, tokens)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+
+    def loss_fused(p):
+        h = lm.apply({"params": p}, tokens, return_hidden=True)
+        return fused_cross_entropy(
+            h, p["embed"]["embedding"], labels, chunk=8
+        )
+
+    # rtol reflects the deliberate precision split: the fused path runs
+    # bf16 logit matmuls (fp32 accumulate); the logits path is fp32.
+    np.testing.assert_allclose(
+        float(loss_fused(params)), float(loss_logits(params)), rtol=1e-2
+    )
+    g1 = jax.grad(loss_logits)(params)
+    g2 = jax.grad(loss_fused)(params)
+    for k in ["embed", "layer_0", "final_norm"]:
+        l1 = jax.tree_util.tree_leaves(g1[k])
+        l2 = jax.tree_util.tree_leaves(g2[k])
+        for a, b in zip(l1, l2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-2, atol=5e-3
+            )
+
+
+def test_transformer_lm_remat_same_loss_and_grads():
+    """remat=True must be numerically identical (same math, recomputed)."""
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    rng = np.random.RandomState(1)
+    tokens = jnp.asarray(rng.randint(0, 32, size=(2, 8)), jnp.int32)
+    base = dict(vocab=32, d_model=16, n_heads=2, d_ff=32, n_layers=2,
+                max_len=8)
+    lm = TransformerLM(**base)
+    lm_r = TransformerLM(**base, remat=True)
+    params = lm.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    def loss(m, p):
+        return (m.apply({"params": p}, tokens) ** 2).mean()
+
+    np.testing.assert_allclose(
+        float(loss(lm, params)), float(loss(lm_r, params)), rtol=1e-6
+    )
+    g1 = jax.tree_util.tree_leaves(jax.grad(lambda p: loss(lm, p))(params))
+    g2 = jax.tree_util.tree_leaves(jax.grad(lambda p: loss(lm_r, p))(params))
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=1e-7)
