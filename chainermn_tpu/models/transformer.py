@@ -238,7 +238,8 @@ class TransformerLM(nn.Module):
     n_kv_heads: Optional[int] = None  # GQA/MQA (divides n_heads)
 
     @nn.compact
-    def __call__(self, tokens, position_offset=None, return_hidden=False):
+    def __call__(self, tokens, position_offset=None, return_hidden=False,
+                 inputs_embeds=None):
         """``position_offset``: global position of this shard's first token —
         pass ``axis_index * S_local`` when the sequence dimension is sharded
         (sequence parallelism); requires a sequence-aware ``attention_fn``
@@ -252,12 +253,19 @@ class TransformerLM(nn.Module):
         materializes the ``(B*S, vocab)`` logits the default
         ``embed.attend`` path does.
 
+        ``inputs_embeds``: optional pre-computed ``(B, S, d_model)`` token
+        embeddings replacing the internal table lookup (positions are
+        still added here) — the entry point for a VOCAB-SHARDED embedding
+        (``parallel.sharding.vocab_parallel_embed``), whose table lives
+        outside this module's replicated parameters.  Combine with
+        ``return_hidden=True`` so the (equally vocab-sharded) LM head
+        runs outside too.
+
         ``remat=True`` wraps every layer in ``jax.checkpoint``: backward
         recomputes layer activations instead of storing ~6 per-layer
         tensors — the standard long-context memory/FLOP trade."""
         import jax.lax as _lax
 
-        embed = nn.Embed(self.vocab, self.d_model, dtype=self.dtype, name="embed")
         pe = jnp.asarray(sinusoidal_positions(self.max_len, self.d_model))
         S = tokens.shape[1]
         if position_offset is None:
@@ -266,7 +274,22 @@ class TransformerLM(nn.Module):
             pos = pe[position_offset]      # explicit per-token positions
         else:
             pos = _lax.dynamic_slice_in_dim(pe, position_offset, S, axis=0)
-        x = embed(tokens) + pos[None].astype(self.dtype)
+        if inputs_embeds is None:
+            embed = nn.Embed(
+                self.vocab, self.d_model, dtype=self.dtype, name="embed"
+            )
+            x = embed(tokens)
+        else:
+            if not return_hidden:
+                raise ValueError(
+                    "inputs_embeds requires return_hidden=True: the tied "
+                    "embed.attend head has no table when the lookup is "
+                    "external (vocab-sharded) — compute the head with "
+                    "the same external table"
+                )
+            embed = None
+            x = inputs_embeds.astype(self.dtype)
+        x = x + pos[None].astype(self.dtype)
         # Pluggable attention (flash/ring/ulysses) imposes its own
         # causality and ignores the mask argument — skip materializing
         # the (S, S) mask, which at long context is the largest host

@@ -54,21 +54,39 @@ def _chunk_logits(h_c, emb):
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _fused_ce_sum(hidden, embedding, labels, chunk):
-    """Sum over valid tokens of ``lse(logits_i) - logits_i[label_i]`` and
-    the valid-token count.  ``labels < 0`` are ignored (0 loss, 0 grad).
+class LocalVocabStrategy:
+    """Reduction strategy for a FULL vocabulary on one device: every
+    merge is the identity and every label row is locally resolvable.
 
-    hidden: (N, D); embedding: (V, D); labels: (N,) int32.
-    Returns (loss_sum fp32, n_valid fp32, lse (N,) fp32).
-    """
-    loss_sum, n_valid, lse = _fused_ce_fwd_impl(
-        hidden, embedding, labels, chunk
-    )
-    return loss_sum, n_valid, lse
+    The vocab-parallel cross-entropy
+    (``parallel.sharding.vocab_parallel_cross_entropy``) swaps in a
+    strategy whose merges are ``pmax``/``psum`` over the model axis and
+    whose label resolution is ownership-masked — same math, one
+    implementation of the chunked scan to maintain."""
+
+    def merge_max(self, m):
+        return m
+
+    def merge_sum(self, s):
+        return s
+
+    def merge_pick(self, p):
+        return p
+
+    def reduce_dh(self, dh):
+        return dh
+
+    def label_local(self, labels):
+        """(local row index, ownership mask).  Locally every valid label
+        is owned; invalid (< 0) labels are owned nowhere."""
+        return jnp.maximum(labels, 0), labels >= 0
 
 
-def _fused_ce_fwd_impl(hidden, embedding, labels, chunk):
+def ce_scan_fwd(hidden, embedding, labels, chunk, strat):
+    """Chunked CE forward: sum over valid tokens of ``lse - picked`` plus
+    the valid count and per-token lse, never holding more than one
+    ``(chunk, V_local)`` logit tile.  ``strat`` supplies the cross-shard
+    merges (identity for the local case)."""
     N = hidden.shape[0]
     C = _pick_chunk(N, chunk)
     h_chunks = hidden.reshape(N // C, C, hidden.shape[1])
@@ -77,16 +95,20 @@ def _fused_ce_fwd_impl(hidden, embedding, labels, chunk):
     def body(carry, hc_lc):
         loss_sum, n_valid = carry
         h_c, l_c = hc_lc
-        logits = _chunk_logits(h_c, embedding)  # (C, V) fp32
-        m = jnp.max(logits, axis=-1)
-        lse_c = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1))
+        logits = _chunk_logits(h_c, embedding)  # (C, V_local) fp32
+        m = strat.merge_max(jnp.max(logits, axis=-1))
+        se = strat.merge_sum(
+            jnp.sum(jnp.exp(logits - m[:, None]), axis=-1)
+        )
+        lse_c = m + jnp.log(se)
         valid = l_c >= 0
-        picked = jnp.take_along_axis(
-            logits, jnp.maximum(l_c, 0)[:, None], axis=-1
-        )[:, 0]
+        idx, owner = strat.label_local(l_c)
+        picked_s = jnp.take_along_axis(logits, idx[:, None], axis=-1)[:, 0]
+        picked = strat.merge_pick(jnp.where(owner, picked_s, 0.0))
         tok_loss = jnp.where(valid, lse_c - picked, 0.0)
         return (
-            (loss_sum + tok_loss.sum(), n_valid + valid.sum().astype(jnp.float32)),
+            (loss_sum + tok_loss.sum(),
+             n_valid + valid.sum().astype(jnp.float32)),
             lse_c,
         )
 
@@ -96,16 +118,12 @@ def _fused_ce_fwd_impl(hidden, embedding, labels, chunk):
     return loss_sum, n_valid, lse.reshape(N)
 
 
-def _fused_ce_vjp_fwd(hidden, embedding, labels, chunk):
-    loss_sum, n_valid, lse = _fused_ce_fwd_impl(
-        hidden, embedding, labels, chunk
-    )
-    return (loss_sum, n_valid, lse), (hidden, embedding, labels, lse)
-
-
-def _fused_ce_vjp_bwd(chunk, res, cots):
-    hidden, embedding, labels, lse = res
-    g_loss, _g_nvalid, g_lse = cots
+def ce_scan_bwd(hidden, embedding, labels, lse, g_loss, g_lse, chunk,
+                strat):
+    """Chunked CE backward: recompute each chunk's logits from the saved
+    lse (remat), assemble ``dlogits = g*(p - onehot) + g_lse*p``, and
+    accumulate ``d embedding`` in the scan carry.  Returns (dh, d_emb) in
+    the input dtypes."""
     N, D = hidden.shape
     C = _pick_chunk(N, chunk)
     h_chunks = hidden.reshape(N // C, C, D)
@@ -116,19 +134,21 @@ def _fused_ce_vjp_bwd(chunk, res, cots):
     def body(d_emb, args):
         h_c, l_c, lse_c, g_lse_c = args
         logits = _chunk_logits(h_c, embedding)  # recompute (remat)
-        p = jnp.exp(logits - lse_c[:, None])  # softmax via saved lse
+        p = jnp.exp(logits - lse_c[:, None])    # softmax (local shard)
         valid = (l_c >= 0)[:, None]
-        onehot = jax.nn.one_hot(jnp.maximum(l_c, 0), logits.shape[1],
-                                dtype=p.dtype)
+        idx, owner = strat.label_local(l_c)
+        onehot = jax.nn.one_hot(
+            idx, logits.shape[1], dtype=p.dtype
+        ) * owner[:, None]
         # d loss_sum / d logits = (p - onehot) per valid token;
         # d lse / d logits = p (lse is an output in its own right).
         dlogits = jnp.where(
             valid, g_loss * (p - onehot), 0.0
         ) + g_lse_c[:, None] * p
-        dh_c = jnp.dot(
+        dh_c = strat.reduce_dh(jnp.dot(
             dlogits.astype(jnp.bfloat16), embedding.astype(jnp.bfloat16),
             preferred_element_type=jnp.float32,
-        )
+        ))
         d_emb = d_emb + jax.lax.dot_general(
             dlogits.astype(jnp.bfloat16), h_c.astype(jnp.bfloat16),
             (((0,), (0,)), ((), ())),
@@ -144,8 +164,35 @@ def _fused_ce_vjp_bwd(chunk, res, cots):
     return (
         dh.reshape(N, D).astype(hidden.dtype),
         d_emb.astype(embedding.dtype),
-        None,
     )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused_ce_sum(hidden, embedding, labels, chunk):
+    """Sum over valid tokens of ``lse(logits_i) - logits_i[label_i]`` and
+    the valid-token count.  ``labels < 0`` are ignored (0 loss, 0 grad).
+
+    hidden: (N, D); embedding: (V, D); labels: (N,) int32.
+    Returns (loss_sum fp32, n_valid fp32, lse (N,) fp32).
+    """
+    return ce_scan_fwd(hidden, embedding, labels, chunk,
+                       LocalVocabStrategy())
+
+
+def _fused_ce_vjp_fwd(hidden, embedding, labels, chunk):
+    out = ce_scan_fwd(hidden, embedding, labels, chunk,
+                      LocalVocabStrategy())
+    return out, (hidden, embedding, labels, out[2])
+
+
+def _fused_ce_vjp_bwd(chunk, res, cots):
+    hidden, embedding, labels, lse = res
+    g_loss, _g_nvalid, g_lse = cots
+    dh, d_emb = ce_scan_bwd(
+        hidden, embedding, labels, lse, g_loss, g_lse, chunk,
+        LocalVocabStrategy(),
+    )
+    return dh, d_emb, None
 
 
 _fused_ce_sum.defvjp(_fused_ce_vjp_fwd, _fused_ce_vjp_bwd)

@@ -10,6 +10,8 @@ those and supplies the net-new strategies the task requires.
 from chainermn_tpu.parallel.sharding import (  # noqa: F401
     transformer_param_spec,
     make_gspmd_train_step,
+    vocab_parallel_cross_entropy,
+    vocab_parallel_embed,
 )
 
 

@@ -20,11 +20,13 @@ two-plane split:
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 import optax
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -145,3 +147,213 @@ def make_gspmd_train_step(
         donate_argnums=(0, 1),
     )
     return jitted, shard_fn
+
+
+# ---------------------------------------------------------------------------
+# Vocab-parallel embedding + cross-entropy (Megatron-style TP for the LM
+# head).  The embedding table's VOCAB axis is sharded over the model axis;
+# the logits never exist unsharded — each device holds (chunk, V/n) tiles
+# and the softmax statistics merge with one pmax + psum per chunk, the
+# reference's allreduce contract applied to the softmax instead of the
+# gradients (REF:chainermn/functions/collective_communication.py is the
+# differentiable-collective precedent).
+#
+# Both ops are explicit custom_vjps: differentiating lax.psum inside these
+# shard_map regions (replication tracking off) would transpose psum to
+# psum and inflate gradients by the axis size, so the backward collectives
+# are written by hand — dh = psum over shards of dlogits_s @ E_s; dE_s is
+# purely local.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def vocab_parallel_embed(tokens, embedding_shard, axis_name,
+                         grad_reduce=False):
+    """Token lookup against a VOCAB-SHARDED embedding table, inside
+    ``shard_map`` over ``axis_name``.
+
+    ``embedding_shard``: ``(V/n, D)`` — this device's contiguous vocab
+    rows (shard ``i`` owns ids ``[i*V/n, (i+1)*V/n)``).  Each device
+    resolves the ids it owns (others contribute zeros) and one ``psum``
+    assembles the replicated ``(..., D)`` activations — O(tokens x D)
+    wire, table stays sharded (the per-device memory win TP exists for).
+
+    ``grad_reduce`` (static): the backward collective for the table.
+    False (default) is the pure-TP contract — downstream cotangents are
+    REPLICATED over ``axis_name``, so each device's local scatter is the
+    complete gradient for its shard.  True is the SP-composed contract —
+    downstream consumes only a per-device slice of the output (sequence
+    parallelism over the SAME axis), so cotangents arrive as
+    device-varying zero-masked slices; the backward ``psum``s the
+    COTANGENT first (reassembling the full replicated ``dL/d out``) and
+    then scatters locally, so each shard collects every sequence
+    position's contribution to its own rows.  (Scattering first and
+    psum-ing the scattered shards would be wrong twice over: a device
+    drops cotangents for ids outside its own vocab range, and the psum
+    would mix different shards' row spaces.)
+    """
+    out, _ = _vp_embed_fwd_impl(tokens, embedding_shard, axis_name)
+    return out
+
+
+def _vp_embed_fwd_impl(tokens, embedding_shard, axis_name):
+    i = lax.axis_index(axis_name)
+    v_loc = embedding_shard.shape[0]
+    local = tokens - i * v_loc
+    in_range = jnp.logical_and(local >= 0, local < v_loc)
+    idx = jnp.clip(local, 0, v_loc - 1)
+    emb = jnp.take(embedding_shard, idx, axis=0)
+    emb = jnp.where(in_range[..., None], emb, 0.0)
+    return lax.psum(emb, axis_name), (idx, in_range)
+
+
+def _vp_embed_vjp_fwd(tokens, embedding_shard, axis_name, grad_reduce):
+    out, (idx, in_range) = _vp_embed_fwd_impl(
+        tokens, embedding_shard, axis_name
+    )
+    return out, (idx, in_range, embedding_shard.shape)
+
+
+def _vp_embed_vjp_bwd(axis_name, grad_reduce, res, g):
+    idx, in_range, shape = res
+    if grad_reduce:
+        # Device-varying (zero-masked slice) cotangents: reassemble the
+        # full replicated dL/d out BEFORE the ownership-masked scatter.
+        g = lax.psum(g, axis_name)
+    g_masked = jnp.where(in_range[..., None], g, 0.0)
+    d_emb = jnp.zeros(shape, g.dtype).at[idx.reshape(-1)].add(
+        g_masked.reshape(-1, shape[-1])
+    )
+    return None, d_emb
+
+
+vocab_parallel_embed.defvjp(_vp_embed_vjp_fwd, _vp_embed_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_seq_for_replicated_head(x, axis_name, axis=1):
+    """All-gather a sequence-sharded activation for a head whose gradient
+    is REPLICATED over ``axis_name`` (the vocab-parallel CE) — Megatron's
+    g/ḡ conjugate-collective pair.
+
+    Every device seeds the identical replicated cotangent on the gathered
+    tensor, so a plain ``lax.all_gather``'s transpose (reduce-scatter)
+    would sum the ``n`` identical copies and inflate every upstream
+    gradient by the axis size.  This version's backward SLICES the
+    replicated cotangent back to the caller's shard — the correct 1x
+    adjoint when (and only when) the downstream consumer produces a
+    replicated gradient, as the explicit-collective CE here does.
+    """
+    return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def _gather_head_vjp_fwd(x, axis_name, axis):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=True), x.shape[axis]
+
+
+def _gather_head_vjp_bwd(axis_name, axis, s_local, g):
+    my = lax.axis_index(axis_name)
+    return (lax.dynamic_slice_in_dim(g, my * s_local, s_local, axis),)
+
+
+gather_seq_for_replicated_head.defvjp(
+    _gather_head_vjp_fwd, _gather_head_vjp_bwd
+)
+
+
+class _VocabShardStrategy:
+    """:class:`chainermn_tpu.ops.fused_ce.LocalVocabStrategy`'s
+    cross-shard sibling: row max/sum-exp/picked-logit merge over the
+    model axis (pmax + psum), labels resolved by contiguous-shard
+    ownership, and the backward's ``dh`` summed across shards (``dh =
+    Σ_s dlogits_s @ E_s``).  The chunked scan itself lives once, in
+    ``ops.fused_ce``."""
+
+    def __init__(self, axis_name, v_loc):
+        self.axis_name = axis_name
+        self.v_loc = v_loc
+        self.offset = lax.axis_index(axis_name) * v_loc
+
+    def merge_max(self, m):
+        return lax.pmax(m, self.axis_name)
+
+    def merge_sum(self, s):
+        return lax.psum(s, self.axis_name)
+
+    def merge_pick(self, p):
+        return lax.psum(p, self.axis_name)
+
+    def reduce_dh(self, dh):
+        return lax.psum(dh, self.axis_name)
+
+    def label_local(self, labels):
+        local = labels - self.offset
+        owner = jnp.logical_and(local >= 0, local < self.v_loc)
+        return jnp.clip(local, 0, self.v_loc - 1), owner
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _vp_ce_sum(hidden, embedding_shard, labels, axis_name, chunk):
+    """Replicated (loss_sum, n_valid, lse) over vocab-sharded logits."""
+    from chainermn_tpu.ops.fused_ce import ce_scan_fwd
+
+    return ce_scan_fwd(
+        hidden, embedding_shard, labels, chunk,
+        _VocabShardStrategy(axis_name, embedding_shard.shape[0]),
+    )
+
+
+def _vp_ce_vjp_fwd(hidden, embedding_shard, labels, axis_name, chunk):
+    from chainermn_tpu.ops.fused_ce import ce_scan_fwd
+
+    out = ce_scan_fwd(
+        hidden, embedding_shard, labels, chunk,
+        _VocabShardStrategy(axis_name, embedding_shard.shape[0]),
+    )
+    return out, (hidden, embedding_shard, labels, out[2])
+
+
+def _vp_ce_vjp_bwd(axis_name, chunk, res, cots):
+    from chainermn_tpu.ops.fused_ce import ce_scan_bwd
+
+    hidden, embedding_shard, labels, lse = res
+    g_loss, _g_nvalid, g_lse = cots
+    dh, d_emb = ce_scan_bwd(
+        hidden, embedding_shard, labels, lse, g_loss, g_lse, chunk,
+        _VocabShardStrategy(axis_name, embedding_shard.shape[0]),
+    )
+    return dh, d_emb, None
+
+
+_vp_ce_sum.defvjp(_vp_ce_vjp_fwd, _vp_ce_vjp_bwd)
+
+
+def vocab_parallel_cross_entropy(hidden, embedding_shard, labels,
+                                 axis_name: str, *, chunk: int = 512):
+    """Mean softmax cross-entropy against a VOCAB-SHARDED tied embedding,
+    inside ``shard_map`` over ``axis_name`` — the tensor-parallel LM head.
+
+    Semantics of :func:`chainermn_tpu.ops.fused_cross_entropy` (negative
+    labels ignored; bf16 MXU matmuls, fp32 reductions; chunked — no
+    ``(N, V)`` OR ``(N, V/n)`` materialization beyond one
+    ``(chunk, V/n)`` tile per device), with the softmax statistics merged
+    across shards: one ``pmax`` (row max) + two ``psum``s (sum-exp,
+    owner-picked logit) per chunk, and one ``psum`` per chunk in the
+    backward for ``dh``.  Returns the replicated scalar mean; gradients:
+    ``d hidden`` replicated, ``d embedding_shard`` local to each shard.
+
+    Differentiate INSIDE the sharded region (``jax.grad`` of a loss
+    calling this, within the same ``shard_map`` body) — the custom
+    backward issues its own collectives against per-device cotangent
+    seeds.  Differentiating from outside *through* ``shard_map`` layers
+    that transform's own transpose scaling on top and is not supported —
+    the contract every explicit-collective device-plane op in this
+    package shares.
+    """
+    from chainermn_tpu.ops.fused_ce import _validate_and_flatten
+
+    h2, l2 = _validate_and_flatten(hidden, embedding_shard, labels, chunk)
+    loss_sum, n_valid, _lse = _vp_ce_sum(
+        h2, embedding_shard, l2, axis_name, int(chunk)
+    )
+    return loss_sum / jnp.maximum(n_valid, 1.0)

@@ -76,6 +76,12 @@ def main(argv=None):
                    default="bfloat16")
     p.add_argument("--dp", type=int, default=None,
                    help="data-parallel ways (inter axis); rest is sequence")
+    p.add_argument("--vocab-tp", action="store_true",
+                   help="vocab-parallel (Megatron-style) embedding + "
+                        "cross-entropy over the sequence axis: the table "
+                        "and the LM-head logits stay sharded V/n per "
+                        "device (parallel.sharding.vocab_parallel_*); "
+                        "needs --sp != none and vocab %% sp ways == 0")
     p.add_argument("--checkpoint-dir", default=None,
                    help="enable fault tolerance: save/auto-resume via the "
                    "multi-node checkpointer (maybe_load on relaunch)")
@@ -143,6 +149,16 @@ def main(argv=None):
             "sequence parallelism needs intra_size > 1; pass --dp to leave "
             "devices on the intra axis (e.g. --dp 1)"
         )
+    if args.vocab_tp:
+        if args.sp == "none":
+            raise SystemExit("--vocab-tp shards over the sequence axis; "
+                             "pick an --sp mode")
+        if vocab % sp_ways:
+            raise SystemExit(f"--vocab-tp needs vocab ({vocab}) divisible "
+                             f"by sp ways ({sp_ways})")
+        if args.checkpoint_dir:
+            raise SystemExit("--vocab-tp + --checkpoint-dir is not wired "
+                             "up in this example yet")
     if S % max(sp_ways_eff, 1):
         raise SystemExit(f"--seq-len {S} must divide by sp ways {sp_ways_eff}")
     if args.sp == "zigzag" and S % (2 * sp_ways):
@@ -257,13 +273,112 @@ def main(argv=None):
         # base_pos_np rule carried through the shard layout permutation.
         positions = jnp.asarray(base_pos_np[seq_perm], jnp.int32)
 
-        def step(carry, batch):
-            params, opt_state = carry
-            params, opt_state, loss = jitted(params, opt_state, *batch,
-                                             positions)
-            return (params, opt_state), loss
+        if args.vocab_tp:
+            # Megatron-style vocab parallelism over the SAME devices as
+            # the sequence axis: the embedding table and the LM-head
+            # logits live sharded V/n per device; the transformer body
+            # stays sequence-parallel.  The head follows Megatron's
+            # SP+TP composition: all-gather the final hidden states over
+            # the axis, then the vocab-sharded CE merges softmax
+            # statistics with pmax/psum — logits never materialize
+            # beyond a (chunk, V/n) tile per device.
+            from chainermn_tpu.parallel.sharding import (
+                gather_seq_for_replicated_head,
+                vocab_parallel_cross_entropy,
+                vocab_parallel_embed,
+            )
 
-        carry = (params, opt_state)
+            S_loc = S // sp_ways
+            emb0 = params["params"]["embed"]["embedding"]
+            params_rest = {"params": {
+                k: v for k, v in params["params"].items() if k != "embed"
+            }}
+            st_rest0 = opt.init(params_rest)
+            st_emb0 = opt.init(emb0)
+            emb_spec = P("intra")
+            # Optimizer moments are table-shaped: shard them alongside.
+            st_emb_spec = jax.tree.map(
+                lambda x: emb_spec if getattr(x, "ndim", 0) == 2 else P(),
+                st_emb0,
+            )
+
+            def body_vtp(pr, emb, st_r, st_e, tok_f, tgt_f, wt_f, pos_f):
+                my = lax.axis_index("intra")
+
+                def loss_fn(pr, emb):
+                    # grad_reduce=True: the transformer consumes only
+                    # this device's sequence slice, so table cotangents
+                    # arrive device-varying and the embed backward must
+                    # psum across the axis.
+                    x_f = vocab_parallel_embed(
+                        tok_f, emb, "intra", True
+                    )
+                    x_l = lax.dynamic_slice_in_dim(
+                        x_f, my * S_loc, S_loc, 1
+                    )
+                    tok_l = lax.dynamic_slice_in_dim(
+                        tok_f, my * S_loc, S_loc, 1
+                    )
+                    pos_l = lax.dynamic_slice_in_dim(
+                        pos_f, my * S_loc, S_loc, 0
+                    )
+                    h_l = model.apply(
+                        pr, tok_l, position_offset=pos_l,
+                        return_hidden=True, inputs_embeds=x_l,
+                    )
+                    # NOT plain lax.all_gather: the CE's gradient is
+                    # replicated over intra, so all_gather's reduce-
+                    # scatter transpose would inflate every transformer
+                    # gradient by sp_ways.  The head-gather's backward
+                    # slices instead (see sharding.py).
+                    h_f = gather_seq_for_replicated_head(h_l, "intra", 1)
+                    labels = jnp.where(wt_f > 0, tgt_f, -1)
+                    return vocab_parallel_cross_entropy(
+                        h_f, emb, labels, "intra"
+                    )
+
+                loss, (g_r, g_e) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1)
+                )(pr, emb)
+                # Transformer grads: intra devices hold their sequence
+                # shard's partials, inter rows per-row grads — psum
+                # completes both sums; /dp turns the inter sum into the
+                # DP mean (the loss is already a per-row mean).
+                g_r = jax.tree.map(
+                    lambda g: lax.psum(g, comm.axes) / dp, g_r
+                )
+                # Embed-shard grads are intra-complete (both custom vjps
+                # reduce internally); only the DP mean remains.
+                g_e = lax.psum(g_e, "inter") / dp
+                up_r, st_r = opt.update(g_r, st_r, pr)
+                pr = optax.apply_updates(pr, up_r)
+                up_e, st_e = opt.update(g_e, st_e, emb)
+                emb = optax.apply_updates(emb, up_e)
+                return pr, emb, st_r, st_e, lax.pmean(loss, "inter")
+
+            jitted_vtp = jax.jit(comm.shard_map(
+                body_vtp,
+                in_specs=(P(), emb_spec, P(), st_emb_spec,
+                          P("inter"), P("inter"), P("inter"), P()),
+                out_specs=(P(), emb_spec, P(), st_emb_spec, P()),
+            ))
+
+            def step(carry, batch):
+                pr, emb, st_r, st_e = carry
+                pr, emb, st_r, st_e, loss = jitted_vtp(
+                    pr, emb, st_r, st_e, *batch, positions
+                )
+                return (pr, emb, st_r, st_e), loss
+
+            carry = (params_rest, emb0, st_rest0, st_emb0)
+        else:
+            def step(carry, batch):
+                params, opt_state = carry
+                params, opt_state, loss = jitted(params, opt_state, *batch,
+                                                 positions)
+                return (params, opt_state), loss
+
+            carry = (params, opt_state)
 
     rng = np.random.RandomState(0)
     wt_np = np.ones((B, S), np.float32)

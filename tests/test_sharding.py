@@ -129,3 +129,301 @@ def test_param_spec_rejects_unmatched_naming():
     }
     with pytest.raises(ValueError, match="matched NO shardable"):
         transformer_param_spec(foreign)
+
+
+# ---------------------------------------------------------------------------
+# Vocab-parallel embedding + cross-entropy (VERDICT r4 item 6)
+# ---------------------------------------------------------------------------
+
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+@pytest.fixture(scope="module")
+def tp_mesh():
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs 4 devices")
+    return Mesh(np.array(devs[:4]), ("model",))
+
+
+def test_vocab_parallel_embed_matches_take(tp_mesh):
+    from chainermn_tpu.parallel.sharding import vocab_parallel_embed
+
+    V, D = 64, 16
+    emb = jax.random.normal(jax.random.PRNGKey(0), (V, D))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, V)
+
+    f = jax.jit(shard_map(
+        lambda t, e: vocab_parallel_embed(t, e, "model"),
+        mesh=tp_mesh, in_specs=(P(), P("model")), out_specs=P(),
+        check_vma=False,
+    ))
+    out = f(toks, emb)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(jnp.take(emb, toks, axis=0)),
+        rtol=1e-6,
+    )
+
+
+def test_vocab_parallel_embed_grad_matches(tp_mesh):
+    from chainermn_tpu.parallel.sharding import vocab_parallel_embed
+
+    V, D = 64, 16
+    emb = jax.random.normal(jax.random.PRNGKey(0), (V, D))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, V)
+    w = jax.random.normal(jax.random.PRNGKey(2), (2, 12, D))
+
+    # Grad taken INSIDE the sharded region (the op's contract — its
+    # backward scatters each device's cotangent into its own rows).
+    f = jax.jit(shard_map(
+        lambda t, e, w: jax.grad(
+            lambda e: jnp.sum(vocab_parallel_embed(t, e, "model") * w)
+        )(e),
+        mesh=tp_mesh, in_specs=(P(), P("model"), P()),
+        out_specs=P("model"),
+        check_vma=False,
+    ))
+    g1 = f(toks, emb, w)
+
+    def ref_loss(emb):
+        return jnp.sum(jnp.take(emb, toks, axis=0) * w)
+
+    g2 = jax.grad(ref_loss)(emb)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-5,
+                               atol=1e-6)
+
+
+@pytest.mark.parametrize("neg_frac", [0.0, 0.3])
+def test_vocab_parallel_ce_matches_fused(tp_mesh, neg_frac):
+    """Trajectory equality: the vocab-sharded CE must equal the unsharded
+    fused CE (same chunking, same bf16 matmul precision) in value and in
+    both gradients."""
+    from chainermn_tpu.ops.fused_ce import fused_cross_entropy
+    from chainermn_tpu.parallel.sharding import vocab_parallel_cross_entropy
+
+    N, D, V = 48, 16, 64
+    rng = np.random.RandomState(0)
+    h = jnp.asarray(rng.randn(N, D).astype(np.float32))
+    emb = jnp.asarray(rng.randn(V, D).astype(np.float32) * 0.1)
+    lab = rng.randint(0, V, size=N)
+    if neg_frac:
+        lab[rng.rand(N) < neg_frac] = -1
+    lab = jnp.asarray(lab, jnp.int32)
+
+    # Gradients are taken INSIDE the sharded region (the op's contract,
+    # like every explicit-collective device-plane op: the custom backward
+    # issues its own psum, so each device seeds cotangent 1 and receives
+    # the replicated dh / its local dE shard directly).
+    def tp_value_and_grads(h, emb):
+        f = shard_map(
+            lambda h, e, l: jax.value_and_grad(
+                lambda h, e: vocab_parallel_cross_entropy(
+                    h, e, l, "model", chunk=16
+                ), argnums=(0, 1),
+            )(h, e),
+            mesh=tp_mesh, in_specs=(P(), P("model"), P()),
+            out_specs=(P(), (P(), P("model"))),
+            check_vma=False,
+        )
+        return f(h, emb, lab)
+
+    def ref_loss(h, emb):
+        return fused_cross_entropy(h, emb, lab, chunk=16)
+
+    loss, g1 = jax.jit(tp_value_and_grads)(h, emb)
+    np.testing.assert_allclose(
+        float(loss), float(ref_loss(h, emb)), rtol=2e-3
+    )
+    g2 = jax.grad(ref_loss, argnums=(0, 1))(h, emb)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-2, atol=2e-3
+        )
+
+
+def test_vocab_parallel_ce_no_full_logits_per_device(tp_mesh):
+    """The TP memory claim: inside the sharded region, no intermediate
+    carries a full-vocab axis — every logit-like array is at most
+    (chunk, V/n) per device."""
+    from chainermn_tpu.parallel.sharding import vocab_parallel_cross_entropy
+
+    N, D, V, chunk = 1024, 8, 256, 32
+    n_shards = 4
+    h = jnp.zeros((N, D), jnp.bfloat16)
+    emb = jnp.zeros((V, D), jnp.float32)
+    lab = jnp.zeros((N,), jnp.int32)
+
+    f = shard_map(
+        lambda h, e, l: jax.grad(
+            lambda h, e: vocab_parallel_cross_entropy(
+                h, e, l, "model", chunk=chunk
+            ), argnums=(0, 1),
+        )(h, e),
+        mesh=tp_mesh, in_specs=(P(), P("model"), P()),
+        out_specs=(P(), P("model")),
+        check_vma=False,
+    )
+    jaxpr = jax.make_jaxpr(f)(h, emb, lab)
+
+    v_loc = V // n_shards
+    biggest_rows = 0
+    has_vocab_axis = False
+
+    def walk(jx):
+        nonlocal biggest_rows, has_vocab_axis
+        for eqn in jx.eqns:
+            for var in eqn.outvars:
+                shape = getattr(var.aval, "shape", ())
+                if len(shape) >= 2 and shape[-1] in (v_loc, V):
+                    if shape[-1] == V and shape[-2] > 1:
+                        has_vocab_axis = True
+                    if shape[-1] == v_loc:
+                        biggest_rows = max(
+                            biggest_rows, int(np.prod(shape[:-1]))
+                        )
+            for p in eqn.params.values():
+                sub = p.jaxpr if hasattr(p, "jaxpr") else p
+                if hasattr(sub, "eqns"):
+                    walk(sub)
+
+    walk(jaxpr.jaxpr)
+    assert biggest_rows <= chunk, biggest_rows
+    assert not has_vocab_axis, "a full-vocab intermediate exists"
+
+
+def test_vocab_parallel_embed_grad_reduce_sliced_cotangents(tp_mesh):
+    """The SP-composed contract (grad_reduce=True): downstream consumes
+    only a per-device sequence slice, so table cotangents arrive
+    device-varying; each shard must still collect EVERY position's
+    contribution to its rows (cotangent-psum-then-scatter).  Exact
+    equality vs the dense take() oracle."""
+    from chainermn_tpu.parallel.sharding import vocab_parallel_embed
+
+    n = 4
+    V, D, B, S = 64, 16, 2, 16
+    S_loc = S // n
+    emb = jax.random.normal(jax.random.PRNGKey(0), (V, D))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0, V)
+    w = jax.random.normal(jax.random.PRNGKey(2), (B, S, D))
+
+    def body(toks, emb, w):
+        my = jax.lax.axis_index("model")
+
+        def local_loss(emb):
+            x_f = vocab_parallel_embed(toks, emb, "model", True)
+            x_l = jax.lax.dynamic_slice_in_dim(x_f, my * S_loc, S_loc, 1)
+            w_l = jax.lax.dynamic_slice_in_dim(w, my * S_loc, S_loc, 1)
+            return jnp.sum(x_l * w_l)
+
+        return jax.grad(local_loss)(emb)
+
+    g1 = jax.jit(shard_map(
+        body, mesh=tp_mesh, in_specs=(P(), P("model"), P()),
+        out_specs=P("model"),
+        check_vma=False,
+    ))(toks, emb, w)
+
+    g2 = jax.grad(lambda e: jnp.sum(jnp.take(e, toks, axis=0) * w))(emb)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_gather_seq_for_replicated_head_grad_is_1x(tp_mesh):
+    """The head-gather's backward slices the replicated cotangent —
+    upstream gradients come back exactly 1x (a plain all_gather's
+    reduce-scatter transpose would inflate them by the axis size)."""
+    from chainermn_tpu.parallel.sharding import (
+        gather_seq_for_replicated_head,
+    )
+
+    n = 4
+    B, S, D = 2, 16, 8
+    S_loc = S // n
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, S, D))
+    w = jax.random.normal(jax.random.PRNGKey(1), (B, S, D))
+
+    def body(x, w):
+        my = jax.lax.axis_index("model")
+        x_l = jax.lax.dynamic_slice_in_dim(x, my * S_loc, S_loc, 1)
+
+        def local_loss(x_l):
+            # A replicated-gradient head: every device computes the same
+            # loss from the gathered tensor.
+            x_f = gather_seq_for_replicated_head(x_l, "model", 1)
+            return jnp.sum(x_f * w)
+
+        g_l = jax.grad(local_loss)(x_l)
+        # Reassemble per-device slices for comparison.
+        return jax.lax.all_gather(g_l, "model", axis=1, tiled=True)
+
+    g1 = jax.jit(shard_map(
+        body, mesh=tp_mesh, in_specs=(P(), P()), out_specs=P(),
+        check_vma=False,
+    ))(x, w)
+    g2 = jax.grad(lambda x: jnp.sum(x * w))(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-6)
+
+
+def test_sp_vocab_tp_end_to_end_grads_match(tp_mesh):
+    """The full --vocab-tp composition on a miniature model: sharded
+    embed -> slice to sequence shard -> (stand-in transformer layer) ->
+    head-gather -> vocab-parallel CE.  Table AND layer gradients must
+    match the dense end-to-end oracle exactly (not just track its loss
+    trajectory)."""
+    from chainermn_tpu.ops.fused_ce import fused_cross_entropy
+    from chainermn_tpu.parallel.sharding import (
+        gather_seq_for_replicated_head,
+        vocab_parallel_cross_entropy,
+        vocab_parallel_embed,
+    )
+
+    n = 4
+    V, D, B, S = 64, 16, 2, 16
+    S_loc = S // n
+    emb = jax.random.normal(jax.random.PRNGKey(0), (V, D)) * 0.3
+    wlayer = jax.random.normal(jax.random.PRNGKey(1), (D, D)) * 0.3
+    toks = jax.random.randint(jax.random.PRNGKey(2), (B, S), 0, V)
+    labels = jax.random.randint(jax.random.PRNGKey(3), (B, S), 0, V)
+
+    def body(toks, labels, emb, wlayer):
+        my = jax.lax.axis_index("model")
+
+        def local_loss(emb, wlayer):
+            x_f = vocab_parallel_embed(toks, emb, "model", True)
+            x_l = jax.lax.dynamic_slice_in_dim(x_f, my * S_loc, S_loc, 1)
+            h_l = jnp.tanh(x_l @ wlayer)
+            h_f = gather_seq_for_replicated_head(h_l, "model", 1)
+            return vocab_parallel_cross_entropy(
+                h_f, emb, labels, "model", chunk=8
+            )
+
+        loss, (ge, gw) = jax.value_and_grad(
+            local_loss, argnums=(0, 1)
+        )(emb, wlayer)
+        # Layer grads are per-sequence-shard partials: psum completes.
+        return loss, ge, jax.lax.psum(gw, "model")
+
+    loss, ge, gw = jax.jit(shard_map(
+        body, mesh=tp_mesh,
+        in_specs=(P(), P(), P("model"), P()),
+        out_specs=(P(), P("model"), P()),
+        check_vma=False,
+    ))(toks, labels, emb, wlayer)
+
+    def ref_loss(emb, wlayer):
+        x = jnp.take(emb, toks, axis=0)
+        h = jnp.tanh(x @ wlayer)
+        return fused_cross_entropy(h, emb, labels, chunk=8)
+
+    ref_l, (ref_ge, ref_gw) = jax.value_and_grad(
+        ref_loss, argnums=(0, 1)
+    )(emb, wlayer)
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=2e-3)
+    np.testing.assert_allclose(np.asarray(ge), np.asarray(ref_ge),
+                               rtol=5e-2, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(ref_gw),
+                               rtol=5e-2, atol=2e-3)
