@@ -89,6 +89,98 @@ def collective_profile(comm, nbytes: int, dtype) -> dict:
     }
 
 
+_COLLECTIVES = ("psum", "reduce_scatter", "all_gather", "ppermute",
+                "all_to_all")
+
+
+def _eqn_axes(eqn):
+    """Mesh-axis names a collective eqn runs over, as a tuple."""
+    for key in ("axes", "axis_name"):
+        if key in eqn.params:
+            ax = eqn.params[key]
+            if isinstance(ax, (tuple, list)):
+                out = []
+                for a in ax:
+                    out.extend(a) if isinstance(a, (tuple, list)) \
+                        else out.append(a)
+                return tuple(out)
+            return (ax,)
+    return ()
+
+
+def bytes_per_leg(comm, nbytes: int, dtype) -> dict:
+    """Static per-mesh-axis collective OPERAND bytes from the traced
+    ``allreduce_grad`` — the wire-cost structure of each backend's
+    algorithm, readable without any multi-chip hardware.
+
+    For every collective in the lowering, the per-device operand size is
+    charged to each mesh axis the op runs over.  This pins the
+    two_dimensional backend's bandwidth claim STATICALLY: its inter-axis
+    (DCN-analogue) traffic must be the flat backend's divided by
+    ``intra_size``, because the inter psum runs on the
+    ``reduce_scatter``'d 1/intra shard (SURVEY §2.1 two-dimensional row;
+    the reference's rationale for the 2D algorithm on >1 GbE clusters).
+    """
+    import jax
+
+    n = comm.device_size
+    elems = max(1, nbytes // np.dtype(dtype).itemsize)
+    spec = comm._world_spec
+
+    def body(tree):
+        sq = jax.tree.map(lambda x: jnp.squeeze(x, 0), tree)
+        out = comm.allreduce_grad(sq)
+        return jax.tree.map(lambda x: x[None], out)
+
+    jaxpr = jax.make_jaxpr(comm.shard_map(
+        body, in_specs=({"g": spec},), out_specs={"g": spec}
+    ))({"g": jnp.ones((n, elems), dtype)})
+
+    per_axis: dict = {}
+
+    def walk(jp):
+        for eqn in jp.eqns:
+            if eqn.primitive.name in _COLLECTIVES:
+                op_bytes = sum(
+                    int(np.prod(v.aval.shape))
+                    * np.dtype(v.aval.dtype).itemsize
+                    for v in eqn.invars
+                    if hasattr(v.aval, "shape")
+                )
+                for ax in _eqn_axes(eqn):
+                    per_axis[str(ax)] = per_axis.get(str(ax), 0) + op_bytes
+            for val in eqn.params.values():
+                if hasattr(val, "eqns"):
+                    walk(val)
+                elif hasattr(val, "jaxpr"):
+                    walk(val.jaxpr)
+
+    walk(jaxpr.jaxpr)
+    return per_axis
+
+
+def assert_two_dimensional_inter_savings(profiles: dict,
+                                         intra_size: int) -> None:
+    """``profiles``: {communicator_name: bytes_per_leg dict}.  Asserts the
+    2D claim when both sides are present: two_dimensional's inter-axis
+    operand bytes == flat's / intra_size."""
+    flat = next(
+        (profiles[k] for k in ("flat", "xla_ici", "pure_nccl")
+         if k in profiles), None,
+    )
+    td = profiles.get("two_dimensional")
+    if flat is None or td is None:
+        return
+    flat_inter = flat.get("inter", 0)
+    td_inter = td.get("inter", 0)
+    assert flat_inter > 0 and td_inter > 0, (profiles,)
+    assert td_inter * intra_size == flat_inter, (
+        f"two_dimensional inter-axis bytes {td_inter} x intra "
+        f"{intra_size} != flat's {flat_inter} — the 2D bandwidth claim "
+        "does not hold in the traced lowering"
+    )
+
+
 def bench_one(comm, nbytes: int, dtype, iters: int, warmup: int) -> dict:
     n = comm.device_size
     elems_per_dev = max(1, nbytes // np.dtype(dtype).itemsize)
@@ -156,6 +248,7 @@ def bench_one(comm, nbytes: int, dtype, iters: int, warmup: int) -> dict:
         "time_ms": round(dt * 1e3, 4),
         "algo_bw_GBps": round(payload / dt / 1e9, 4),
         "hlo_collectives": collective_profile(comm, nbytes, dtype),
+        "bytes_per_leg": bytes_per_leg(comm, nbytes, dtype),
     }
 
 
@@ -169,6 +262,12 @@ def main():
                     choices=["float32", "bfloat16", "float16"])
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--static-only", action="store_true",
+                    help="skip timing; print each communicator's "
+                         "jaxpr-level per-axis collective bytes and "
+                         "assert the two_dimensional inter-leg savings "
+                         "claim (runs on any backend, incl. the virtual "
+                         "CPU mesh)")
     args = ap.parse_args()
     if args.iters < 1:
         ap.error("--iters must be >= 1")
@@ -183,6 +282,23 @@ def main():
     import chainermn_tpu
 
     dtype = jnp.dtype(args.dtype)
+    if args.static_only:
+        nbytes = int(float(args.sizes_mb.split(",")[0]) * 2**20)
+        profiles = {}
+        intra = None
+        for name in args.communicators.split(","):
+            comm = chainermn_tpu.create_communicator(name.strip())
+            intra = comm.intra_size
+            profiles[comm.name] = bytes_per_leg(comm, nbytes, dtype)
+            print(json.dumps({
+                "metric": "allreduce_static_bytes_per_leg",
+                "communicator": comm.name,
+                "bytes": nbytes,
+                "per_axis_operand_bytes": profiles[comm.name],
+                "hlo_collectives": collective_profile(comm, nbytes, dtype),
+            }))
+        assert_two_dimensional_inter_savings(profiles, intra)
+        return
     for name in args.communicators.split(","):
         comm = chainermn_tpu.create_communicator(name.strip())
         for mb in args.sizes_mb.split(","):

@@ -1,0 +1,3 @@
+"""Holds the packaged native library (``libhostbuf.so``), compiled by
+setup.py's build hook at install/wheel time.  Empty in source checkouts —
+there ``utils.native`` compiles ``csrc/hostbuf.cpp`` on demand instead."""

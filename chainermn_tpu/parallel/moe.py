@@ -111,22 +111,42 @@ def moe_layer(
     capacity_factor: float = 2.0,
     k: int = 1,
     return_aux: bool = False,
+    experts_per_device: int = 1,
 ):
     """Expert-parallel MoE FFN; call inside ``shard_map`` over ``axis_name``.
 
     ``x``: (T_local, D) this device's tokens.  ``gate_w``: (D, E) router
-    weights (replicated).  ``expert_params``: THIS device's expert's
-    parameters (one expert per device; E = axis size).
-    ``expert_fn(params, tokens) -> tokens`` is the expert computation.
+    weights (replicated), with ``E = axis_size * experts_per_device``.
+    ``expert_params``: THIS device's experts' parameters — for
+    ``experts_per_device == 1`` the bare pytree (back-compat); for more,
+    every leaf leads with an ``(experts_per_device, ...)`` axis and the
+    experts run under ``vmap`` (device ``d`` owns global experts
+    ``d*epd .. (d+1)*epd - 1`` — device-major layout, so the all-to-all's
+    leading-axis split IS the expert→device map).
+    ``expert_fn(params, tokens) -> tokens`` is one expert's computation.
     ``k``: experts per token (1 = Switch, 2 = GShard top-2).
-    ``return_aux``: also return the Switch load-balancing loss for this
-    device's tokens (add to the training loss, typical weight 1e-2).
+    ``return_aux``: also return an aux dict for this device's tokens:
 
-    Returns (T_local, D) with each token replaced by its expert's output
-    weighted by the gate (dropped-by-capacity tokens pass through as zeros,
-    as in Switch)."""
-    E = lax.axis_size(axis_name)
+    * ``"load_balance_loss"`` — the Switch auxiliary loss (add to the
+      training loss, typical weight 1e-2);
+    * ``"dropped_fraction"`` — fraction of the ``k*T`` (token, choice)
+      routings NOT granted a capacity slot (passed through as zeros);
+      the router-health gauge capacity_factor should be tuned against.
+
+    Returns (T_local, D) with each token replaced by its experts' outputs
+    weighted by the gates (dropped-by-capacity tokens pass through as
+    zeros, as in Switch)."""
+    n = lax.axis_size(axis_name)
+    epd = experts_per_device
+    if epd < 1:
+        raise ValueError(f"experts_per_device must be >= 1, got {epd}")
+    E = n * epd
     T, D = x.shape
+    if gate_w.shape[1] != E:
+        raise ValueError(
+            f"gate_w routes to {gate_w.shape[1]} experts but the layout "
+            f"is {n} devices x {epd} experts/device = {E}"
+        )
     capacity = max(1, int(capacity_factor * k * T / E))
 
     gate_logits = x @ gate_w                                # (T, E)
@@ -134,19 +154,46 @@ def moe_layer(
 
     # Gather each expert's slots from local tokens: (E, C, D).
     expert_in = jnp.einsum("ect,td->ecd", dispatch, x.astype(jnp.float32))
-    # All-to-all: device d ends up with ITS expert's slots from every
-    # device: (E, C, D) → (E, C, D) where leading axis is now source device.
+    # All-to-all: the device-major expert axis splits into n chunks of
+    # epd, so device d ends up with ITS experts' slots from every source:
+    # (E, C, D) -> (n*epd, C, D) ordered (source, local expert).
     expert_in = lax.all_to_all(expert_in, axis_name, split_axis=0, concat_axis=0, tiled=True)
-    # Run the local expert on all (E*C) slots.
-    flat = expert_in.reshape(E * capacity, D).astype(x.dtype)
-    out = expert_fn(expert_params, flat).astype(jnp.float32)
-    out = out.reshape(E, capacity, D)
+    if epd == 1:
+        # Run the local expert on all (n*C) slots.
+        flat = expert_in.reshape(n * capacity, D).astype(x.dtype)
+        out = expert_fn(expert_params, flat).astype(jnp.float32)
+        out = out.reshape(n, capacity, D)
+    else:
+        # (source, local expert, C, D) -> per-expert batches, vmapped.
+        grp = (
+            expert_in.reshape(n, epd, capacity, D)
+            .transpose(1, 0, 2, 3)
+            .reshape(epd, n * capacity, D)
+            .astype(x.dtype)
+        )
+        out = jax.vmap(expert_fn)(expert_params, grp).astype(jnp.float32)
+        out = (
+            out.reshape(epd, n, capacity, D)
+            .transpose(1, 0, 2, 3)
+            .reshape(E, capacity, D)
+        )
     # Route back: leading axis returns to expert-major layout per source.
-    out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    out = lax.all_to_all(
+        out.reshape(E, capacity, D), axis_name,
+        split_axis=0, concat_axis=0, tiled=True,
+    )
     # Combine: token t = sum over (e, c) of combine[e,c,t] * out[e,c,:].
     y = jnp.einsum("ect,ecd->td", combine, out).astype(x.dtype)
     if return_aux:
-        return y, load_balancing_loss(gate_logits, E)
+        aux = {
+            "load_balance_loss": load_balancing_loss(gate_logits, E),
+            # dispatch holds exactly one 1 per GRANTED (token, choice);
+            # k*T is every routing the tokens asked for (zero-gate
+            # degenerate choices count as dropped — they carry no output
+            # either way).
+            "dropped_fraction": 1.0 - jnp.sum(dispatch) / (k * T),
+        }
+        return y, aux
     return y
 
 

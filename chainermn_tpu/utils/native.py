@@ -20,10 +20,18 @@ import numpy as np
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _SRC = os.path.join(_REPO_ROOT, "csrc", "hostbuf.cpp")
 _LIB = os.path.join(_REPO_ROOT, "csrc", "libhostbuf.so")
+# Installed trees: setup.py's build hook compiles the library into the
+# package itself (chainermn_tpu/_native/libhostbuf.so) — no toolchain
+# needed at import time.  Preferred when present.
+_PACKAGED_LIB = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "_native", "libhostbuf.so",
+)
 
 _lib_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
+_loaded_from: Optional[str] = None   # "packaged" | "csrc" | None
 
 
 def _build() -> bool:
@@ -38,32 +46,52 @@ def _build() -> bool:
         return False
 
 
+def native_impl() -> Optional[str]:
+    """Which native library is active: ``"packaged"`` (wheel-built
+    ``_native/libhostbuf.so``), ``"csrc"`` (on-demand g++ build in a
+    source checkout), or ``None`` (pure-Python fallbacks)."""
+    get_lib()
+    return _loaded_from
+
+
+def _try_load(path: str):
+    try:
+        return _bind_symbols(ctypes.CDLL(path))
+    except (OSError, AttributeError):
+        # Missing/foreign-arch lib, or a stale .so without the expected
+        # symbols — fall through to the next source in the chain.
+        return None
+
+
 def get_lib() -> Optional[ctypes.CDLL]:
-    """Load (building if needed) the native library; None if unavailable."""
-    global _lib, _load_failed
+    """Load the native library — packaged first, then the on-demand csrc
+    build; None if unavailable (callers use the Python fallbacks)."""
+    global _lib, _load_failed, _loaded_from
     with _lib_lock:
         if _lib is not None or _load_failed:
             return _lib
-        if not os.path.exists(_LIB) or (
-            os.path.exists(_SRC)
-            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+        if os.path.exists(_PACKAGED_LIB):
+            lib = _try_load(_PACKAGED_LIB)
+            if lib is not None:
+                _loaded_from = "packaged"
+                return lib
+        # Source checkout: (re)build when the source is newer; a prebuilt
+        # csrc/libhostbuf.so with the SOURCE stripped still loads (the
+        # symbol check in _try_load guards against stale/foreign .so).
+        if os.path.exists(_SRC) and (
+            not os.path.exists(_LIB)
+            or os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
         ):
             if not _build():
                 _load_failed = True
                 return None
-        try:
-            lib = ctypes.CDLL(_LIB)
-        except OSError:
-            _load_failed = True
-            return None
-        try:
-            return _bind_symbols(lib)
-        except AttributeError:
-            # A stale/prebuilt .so missing expected symbols (e.g. the
-            # source was removed so no rebuild triggered) degrades to the
-            # Python fallback chain instead of raising out of get_lib.
-            _load_failed = True
-            return None
+        if os.path.exists(_LIB):
+            lib = _try_load(_LIB)
+            if lib is not None:
+                _loaded_from = "csrc"
+                return lib
+        _load_failed = True
+        return None
 
 
 def _bind_symbols(lib: ctypes.CDLL) -> ctypes.CDLL:

@@ -1,0 +1,45 @@
+"""Build hook for the native host-buffer library.
+
+The reference compiled its communication binding inside setup.py (the
+Cython NCCL module was part of the install, SURVEY §2.1); the TPU-native
+equivalent is ``csrc/hostbuf.cpp`` — crc32c, threaded pack/unpack, the
+MPMC ring queue — loaded via ctypes.  ``pip install .`` / ``pip wheel .``
+compiles it into ``chainermn_tpu/_native/libhostbuf.so`` so installed
+trees get the native path without a toolchain at import time; the
+in-repo on-demand compile and the pure-Python fallbacks remain for
+source checkouts and toolchain-less hosts (utils/native.py's chain).
+"""
+
+import os
+import subprocess
+import sys
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class build_py_with_native(build_py):
+    def run(self):
+        super().run()
+        here = os.path.dirname(os.path.abspath(__file__))
+        src = os.path.join(here, "csrc", "hostbuf.cpp")
+        dest_dir = os.path.join(self.build_lib, "chainermn_tpu", "_native")
+        os.makedirs(dest_dir, exist_ok=True)
+        out = os.path.join(dest_dir, "libhostbuf.so")
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            "-o", out, src, "-lpthread",
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=300)
+        except Exception as e:  # graceful: the Python fallbacks still work
+            print(
+                "warning: native hostbuf build failed "
+                f"({type(e).__name__}); the installed package will use "
+                "the pure-Python fallbacks (utils/native.py chain)",
+                file=sys.stderr,
+            )
+
+
+setup(cmdclass={"build_py": build_py_with_native})

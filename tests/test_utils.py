@@ -1,5 +1,7 @@
 """Native host-buffer library, collective-order debug mode, profiling."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -258,3 +260,58 @@ def test_typed_array_path_excludes_ndarray_subclasses():
     assert not _is_typed_array(np.ma.masked_array([1, 2], mask=[0, 1]))
     assert not _is_typed_array(np.array([object()]))  # object dtype
     assert not _is_typed_array([1, 2, 3])
+
+
+@pytest.mark.slow
+def test_wheel_builds_and_loads_packaged_native_lib(tmp_path):
+    """VERDICT r4 item 7: ``pip wheel .`` must compile csrc/hostbuf.cpp
+    into the package (setup.py build hook) so an INSTALLED tree — no
+    csrc/, no toolchain assumption — loads the native path, not the
+    silent Python fallback.  Round-trip: build the wheel, unpack it far
+    from the repo, and ask utils.native which source it loaded."""
+    import subprocess
+    import sys
+    import zipfile
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    wheel_dir = tmp_path / "wheels"
+    proc = subprocess.run(
+        [sys.executable, "-m", "pip", "wheel", ".", "--no-deps",
+         "--no-build-isolation", "-w", str(wheel_dir)],
+        cwd=repo, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    wheels = list(wheel_dir.glob("chainermn_tpu-*.whl"))
+    assert len(wheels) == 1, list(wheel_dir.iterdir())
+
+    unpacked = tmp_path / "site"
+    with zipfile.ZipFile(wheels[0]) as zf:
+        names = zf.namelist()
+        assert "chainermn_tpu/_native/libhostbuf.so" in names, names
+        zf.extractall(unpacked)
+
+    check = subprocess.run(
+        [sys.executable, "-c",
+         "from chainermn_tpu.utils import native; "
+         "print('IMPL=' + str(native.native_impl())); "
+         "print('CRC=%08x' % native.crc32c(b'hello world'))"],
+        cwd=str(tmp_path),  # away from the repo: csrc/ not reachable
+        env={**os.environ, "PYTHONPATH": str(unpacked)},
+        capture_output=True, text=True, timeout=120,
+    )
+    assert check.returncode == 0, check.stderr[-2000:]
+    assert "IMPL=packaged" in check.stdout, check.stdout
+    assert "CRC=c99465aa" in check.stdout, check.stdout
+
+
+def test_native_impl_reports_source_checkout():
+    """In this source tree the chain loads the on-demand csrc build (or
+    the packaged lib if one was installed); never silently None while the
+    library is actually available."""
+    from chainermn_tpu.utils import native
+
+    impl = native.native_impl()
+    if native.get_lib() is not None:
+        assert impl in ("packaged", "csrc")
+    else:  # toolchain-less host: fallbacks active, impl honest about it
+        assert impl is None
