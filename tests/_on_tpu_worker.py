@@ -31,6 +31,17 @@ def probe():
     print(jax.default_backend())
 
 
+def _assert_grads_close(g, gref, tol, ctx):
+    """Per-component max relative error: grad magnitudes vary over orders
+    of magnitude, so compare at the scale of the reference gradient."""
+    for a, b, name in zip(g, gref, "qkv"):
+        a32, b32 = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        assert a32.shape == b32.shape, (name, ctx, a32.shape, b32.shape)
+        denom = max(1e-6, float(np.abs(b32).max()))
+        err = float(np.abs(a32 - b32).max()) / denom
+        assert err < tol, (name, ctx, err)
+
+
 def flash():
     from chainermn_tpu.ops.flash_attention import _xla_attention, flash_attention
 
@@ -70,13 +81,7 @@ def flash():
 
         g = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
         gref = jax.jit(jax.grad(loss_xla, argnums=(0, 1, 2)))(q, k, v)
-        for a, b, name in zip(g, gref, "qkv"):
-            a32, b32 = np.asarray(a, np.float32), np.asarray(b, np.float32)
-            # Grad magnitudes vary over orders of magnitude; compare at the
-            # scale of the gradient itself.
-            denom = max(1e-6, float(np.abs(b32).max()))
-            err = float(np.abs(a32 - b32).max()) / denom
-            assert err < 10 * tol, (name, dtype, causal, err)
+        _assert_grads_close(g, gref, 10 * tol, (dtype, causal))
         print(f"flash-on-tpu ok: dtype={jnp.dtype(dtype).name} causal={causal}")
 
     # Segment-id masks (packed sequences), compiled: fwd + grads match the
@@ -132,6 +137,45 @@ def flash():
             rtol=2e-2, atol=2e-2,
         )
         print(f"flash-on-tpu ok: D={D2}")
+
+    # GQA / MQA, COMPILED (the b // G index maps and the widened dkv
+    # grid have Mosaic lowerings of their own — interpret-mode coverage
+    # alone would not pin them): fwd + all three grads vs the
+    # broadcast-kv oracle, for a 2-group and an MQA head layout.
+    for Hk in (2, 1):
+        B3, S3, H3, D3 = 2, 1024, 4, 128
+        q3 = jnp.asarray(rng.randn(B3, S3, H3, D3) * 0.3, jnp.bfloat16)
+        k3 = jnp.asarray(rng.randn(B3, S3, Hk, D3) * 0.3, jnp.bfloat16)
+        v3 = jnp.asarray(rng.randn(B3, S3, Hk, D3) * 0.3, jnp.bfloat16)
+        G = H3 // Hk
+
+        def gqa_ref(q, k, v):
+            return _xla_attention(
+                q, jnp.repeat(k, G, axis=2), jnp.repeat(v, G, axis=2),
+                1.0 / D3**0.5, True,
+            )
+
+        o3 = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(
+            q3, k3, v3
+        )
+        np.testing.assert_allclose(
+            np.asarray(o3, np.float32),
+            np.asarray(gqa_ref(q3, k3, v3), np.float32),
+            rtol=2e-2, atol=2e-2,
+        )
+        g3 = jax.jit(jax.grad(
+            lambda q, k, v: (flash_attention(
+                q, k, v, causal=True
+            ).astype(jnp.float32) ** 2).sum(),
+            argnums=(0, 1, 2),
+        ))(q3, k3, v3)
+        gr3 = jax.jit(jax.grad(
+            lambda q, k, v: (gqa_ref(q, k, v).astype(jnp.float32) ** 2)
+            .sum(),
+            argnums=(0, 1, 2),
+        ))(q3, k3, v3)
+        _assert_grads_close(g3, gr3, 0.2, ("gqa", Hk))
+        print(f"flash-on-tpu ok: GQA Hk={Hk}")
     print("OK")
 
 
