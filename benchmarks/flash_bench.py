@@ -91,6 +91,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=1)
     ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--kv-heads", type=int, default=None,
+                    help="GQA/MQA: K/V head count (divides --heads; "
+                         "1 = MQA).  The kernel streams shared KV blocks "
+                         "via index maps; the XLA baseline broadcasts")
     ap.add_argument("--seq", type=int, default=8192)
     ap.add_argument("--d-head", type=int, default=128)
     ap.add_argument("--dtype", default="bfloat16")
@@ -106,10 +110,15 @@ def main():
     args = ap.parse_args()
 
     B, H, S, D = args.batch, args.heads, args.seq, args.d_head
+    Hk = H if args.kv_heads is None else args.kv_heads
+    if H % Hk:
+        ap.error("--kv-heads must divide --heads")
     dtype = jnp.dtype(args.dtype)
     rng = np.random.RandomState(0)
-    q, k, v = (
-        jnp.asarray(rng.randn(B, S, H, D), dtype) / (D**0.25) for _ in range(3)
+    q = jnp.asarray(rng.randn(B, S, H, D), dtype) / (D**0.25)
+    k, v = (
+        jnp.asarray(rng.randn(B, S, Hk, D), dtype) / (D**0.25)
+        for _ in range(2)
     )
 
     flash = jax.jit(
@@ -118,6 +127,7 @@ def main():
             block_q=args.block_q, block_k=args.block_k,
         )
     )
+    # _xla_attention broadcasts the KV heads itself for GQA shapes.
     xla = jax.jit(lambda q, k, v: _xla_attention(q, k, v, 1 / D**0.5, args.causal))
 
     def make_grad(f):
