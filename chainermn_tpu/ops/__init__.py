@@ -1,8 +1,11 @@
-"""Hand-written TPU kernels (Pallas) for the hot ops.
+"""Hand-written TPU kernels and memory transforms for the hot ops.
 
 The reference had no kernel layer — its math was Chainer's and its only
-"kernels" were pack/unpack copies (SURVEY §1 notes).  On TPU the hot op
-worth hand-scheduling is attention; everything else XLA fuses well.
+"kernels" were pack/unpack copies (SURVEY §1 notes).  On TPU two ops
+earn hand treatment: attention (the Pallas flash kernels — FLOPs and
+O(S²) memory) and the LM loss head (the chunked fused cross-entropy —
+a custom-vjp memory transform that never materializes the logits).
+Everything else XLA fuses well.
 """
 
 from chainermn_tpu.ops.flash_attention import (  # noqa: F401
