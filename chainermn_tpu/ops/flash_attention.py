@@ -51,24 +51,45 @@ except ImportError:  # pragma: no cover
 _NEG_INF = -1e30
 
 
-def _block_mask(shape, causal, q_start, k_start, qs_ref, ks_ref):
+def _block_mask(shape, causal, q_start, k_start, qs_ref, ks_ref,
+                window=None):
     """Combined (block_q, block_k) boolean mask for one grid tile — the
-    causal triangle AND segment-id equality (packed sequences attend only
-    within their own segment).  None when nothing masks."""
+    causal triangle, the sliding-window band (query attends only its
+    ``window`` most recent positions, itself included — Mistral-style
+    local attention), AND segment-id equality (packed sequences attend
+    only within their own segment).  None when nothing masks."""
     m = None
-    if causal:
+    if causal or window is not None:
         q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
         k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
         m = q_pos >= k_pos
+        if window is not None:
+            m = m & (q_pos - k_pos < window)
     if qs_ref is not None:
         seg = qs_ref[0] == ks_ref[0].reshape(1, -1)   # (bq,1) == (1,bk)
         m = seg if m is None else (m & seg)
     return m
 
 
+def _band_live(causal, window, q_start, block_q, k_start, block_k):
+    """Whole-block skip condition: does this (q block, k block) tile
+    intersect the attention band at all?  Causal bound above (no k after
+    the last query), window bound below (no k more than ``window - 1``
+    positions before the first live query of the block)."""
+    run = True
+    if causal:
+        run = k_start <= q_start + block_q - 1
+    if window is not None:
+        run = jnp.logical_and(
+            run, k_start + block_k - 1 >= q_start - (window - 1)
+        )
+    return run
+
+
 def _attn_kernel(
     *refs,
     scale: float, causal: bool, segmented: bool, block_q: int, block_k: int,
+    window=None,
 ):
     if segmented:
         (q_ref, k_ref, v_ref, qs_ref, ks_ref,
@@ -89,10 +110,9 @@ def _attn_kernel(
     q_start = iq * block_q
     k_start = ik * block_k
 
-    # Whole-block causal skip: K block strictly in the future of Q block.
-    run = True
-    if causal:
-        run = k_start <= q_start + block_q - 1
+    # Whole-block skip: K block past the causal bound OR entirely before
+    # the sliding window's reach.
+    run = _band_live(causal, window, q_start, block_q, k_start, block_k)
 
     @pl.when(run)
     def _():
@@ -104,7 +124,8 @@ def _attn_kernel(
         v = v_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
 
-        mask = _block_mask(s.shape, causal, q_start, k_start, qs_ref, ks_ref)
+        mask = _block_mask(s.shape, causal, q_start, k_start, qs_ref,
+                           ks_ref, window)
         if mask is not None:
             s = jnp.where(mask, s, _NEG_INF)
 
@@ -112,12 +133,13 @@ def _attn_kernel(
         m_blk = jnp.max(s, axis=1)
         m_new = jnp.maximum(m_prev, m_blk)
         p = jnp.exp(s - m_new[:, None])
-        if segmented:
+        if segmented or window is not None:
             # A row fully masked in this block has m_new == _NEG_INF ==
             # its masked scores, making exp(s - m_new) = 1 — zero those
             # entries so padding rows accumulate nothing.  (Causal-only
-            # running blocks always have >= 1 valid entry per row, so the
-            # unsegmented kernel never hits this.)
+            # running blocks always have >= 1 valid entry per row; a
+            # windowed block admitted for its LATE rows can have fully-
+            # masked EARLY rows, so the window path needs this too.)
             p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
 
@@ -152,7 +174,7 @@ def _kv_group(BHq: int, BHk: int) -> int:
 
 
 def _flash_bh_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret,
-                  q_seg=None, kv_seg=None):
+                  q_seg=None, kv_seg=None, window=None):
     """(BH, S, D) flash attention forward; returns (o, lse).
 
     ``k``/``v`` may carry FEWER head rows than ``q`` (GQA/MQA): with
@@ -170,7 +192,7 @@ def _flash_bh_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret,
 
     kernel = functools.partial(
         _attn_kernel, scale=scale, causal=causal, segmented=segmented,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q, block_k=block_k, window=window,
     )
     scratch = [
         pltpu.VMEM((block_q, D), jnp.float32),
@@ -209,6 +231,7 @@ def _flash_bh_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret,
 def _dq_kernel(
     *refs,
     scale: float, causal: bool, segmented: bool, block_q: int, block_k: int,
+    window=None,
 ):
     if segmented:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
@@ -227,9 +250,7 @@ def _dq_kernel(
 
     q_start = iq * block_q
     k_start = ik * block_k
-    run = True
-    if causal:
-        run = k_start <= q_start + block_q - 1
+    run = _band_live(causal, window, q_start, block_q, k_start, block_k)
 
     @pl.when(run)
     def _():
@@ -238,11 +259,12 @@ def _dq_kernel(
         v = v_ref[0]
         do = do_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        mask = _block_mask(s.shape, causal, q_start, k_start, qs_ref, ks_ref)
+        mask = _block_mask(s.shape, causal, q_start, k_start, qs_ref,
+                           ks_ref, window)
         if mask is not None:
             s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0, :, :])             # exact probabilities
-        if segmented:
+        if segmented or window is not None:
             # A FULLY-masked row (padding) has lse ~ _NEG_INF, making
             # exp(s - lse) = 1 at masked entries; zero them explicitly.
             p = jnp.where(mask, p, 0.0)
@@ -258,7 +280,7 @@ def _dq_kernel(
 def _dkv_kernel(
     *refs,
     scale: float, causal: bool, segmented: bool, block_q: int, block_k: int,
-    n_q: int,
+    n_q: int, window=None,
 ):
     if segmented:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
@@ -282,10 +304,9 @@ def _dkv_kernel(
 
     q_start = iq * block_q
     k_start = ik * block_k
-    run = True
-    if causal:
-        # Skip when the whole Q block precedes the whole K block.
-        run = q_start + block_q - 1 >= k_start
+    # Skip when the whole Q block precedes the whole K block (causal) or
+    # lies entirely beyond the K block's window reach.
+    run = _band_live(causal, window, q_start, block_q, k_start, block_k)
 
     @pl.when(run)
     def _():
@@ -294,11 +315,12 @@ def _dkv_kernel(
         v = v_ref[0]
         do = do_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        mask = _block_mask(s.shape, causal, q_start, k_start, qs_ref, ks_ref)
+        mask = _block_mask(s.shape, causal, q_start, k_start, qs_ref,
+                           ks_ref, window)
         if mask is not None:
             s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0, :, :])
-        if segmented:
+        if segmented or window is not None:
             p = jnp.where(mask, p, 0.0)  # see _dq_kernel
         pt = p.astype(do.dtype).T
         dv_acc[:] += jnp.dot(pt, do, preferred_element_type=jnp.float32)
@@ -313,7 +335,8 @@ def _dkv_kernel(
 
 
 def _flash_bh_bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
-                  interpret, dlse=None, q_seg=None, kv_seg=None):
+                  interpret, dlse=None, q_seg=None, kv_seg=None,
+                  window=None):
     """(BH, S, D) flash attention backward: (dq, dk, dv).
 
     ``dlse``: optional cotangent of the row log-sum-exp output (used when
@@ -347,7 +370,7 @@ def _flash_bh_bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal, segmented=segmented,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, window=window,
         ),
         out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
         grid=(BH, Sq // block_q, Sk // block_k),
@@ -384,7 +407,7 @@ def _flash_bh_bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal, segmented=segmented,
-            block_q=block_q, block_k=block_k, n_q=n_q,
+            block_q=block_q, block_k=block_k, n_q=n_q, window=window,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((BHk, Sk, D), k.dtype),
@@ -405,30 +428,36 @@ def _flash_bh_bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_bh(q, k, v, scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_bh(q, k, v, scale, causal, block_q, block_k, interpret,
+              window=None):
     """(BH, S, D) flash attention, differentiable (FlashAttention-2-style
     explicit backward: recompute probabilities blockwise from the saved row
     LSE, never materializing the S×S matrix in either pass)."""
     o, _ = _flash_bh_fwd(
         q, k, v, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret,
+        window=window,
     )
     return o
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                   window=None):
     o, lse = _flash_bh_fwd(
         q, k, v, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret,
+        window=window,
     )
     return o, (q, k, v, o, lse)
 
-def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, do):
+def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, window, res,
+                   do):
     q, k, v, o, lse = res
     dq, dk, dv = _flash_bh_bwd(
         q, k, v, o, lse, do, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret,
+        window=window,
     )
     return dq, dk, dv
 
@@ -441,9 +470,9 @@ def _float0_like(x):
     return np.zeros(x.shape, dtype=jax.dtypes.float0)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def _flash_bh_seg(q, k, v, q_seg, kv_seg, scale, causal, block_q, block_k,
-                  interpret):
+                  interpret, window=None):
     """Segment-masked (BH, S, D) flash attention (packed sequences):
     tokens attend only within their own segment id.  Same explicit
     FlashAttention-2 backward; fully-masked (padding) rows produce zero
@@ -451,27 +480,28 @@ def _flash_bh_seg(q, k, v, q_seg, kv_seg, scale, causal, block_q, block_k,
     o, _ = _flash_bh_fwd(
         q, k, v, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret,
-        q_seg=q_seg, kv_seg=kv_seg,
+        q_seg=q_seg, kv_seg=kv_seg, window=window,
     )
     return o
 
 
 def _flash_seg_vjp_fwd(q, k, v, q_seg, kv_seg, scale, causal, block_q,
-                       block_k, interpret):
+                       block_k, interpret, window=None):
     o, lse = _flash_bh_fwd(
         q, k, v, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret,
-        q_seg=q_seg, kv_seg=kv_seg,
+        q_seg=q_seg, kv_seg=kv_seg, window=window,
     )
     return o, (q, k, v, o, lse, q_seg, kv_seg)
 
 
-def _flash_seg_vjp_bwd(scale, causal, block_q, block_k, interpret, res, do):
+def _flash_seg_vjp_bwd(scale, causal, block_q, block_k, interpret, window,
+                       res, do):
     q, k, v, o, lse, q_seg, kv_seg = res
     dq, dk, dv = _flash_bh_bwd(
         q, k, v, o, lse, do, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret,
-        q_seg=q_seg, kv_seg=kv_seg,
+        q_seg=q_seg, kv_seg=kv_seg, window=window,
     )
     return dq, dk, dv, _float0_like(q_seg), _float0_like(kv_seg)
 
@@ -566,7 +596,7 @@ flash_attention_with_lse_seg.defvjp(
 
 
 def _xla_attention(q, k, v, scale, causal, q_segment_ids=None,
-                   kv_segment_ids=None):
+                   kv_segment_ids=None, window=None):
     if k.shape[2] != q.shape[2]:
         # GQA/MQA fallback: broadcast KV heads to the query head count.
         # jnp.repeat's transpose sums the group's dk/dv — exactly the
@@ -581,6 +611,11 @@ def _xla_attention(q, k, v, scale, causal, q_segment_ids=None,
     mask = None
     if causal:
         mask = jnp.tril(jnp.ones((Sq, Sk), bool))[None]
+    if window is not None:
+        band = (
+            jnp.arange(Sq)[:, None] - jnp.arange(Sk)[None, :] < window
+        )[None]
+        mask = band if mask is None else (mask & band)
     if q_segment_ids is not None:
         seg = segment_mask(q_segment_ids, kv_segment_ids)
         mask = seg if mask is None else (mask & seg)
@@ -607,9 +642,16 @@ def flash_attention(
     interpret: Optional[bool] = None,
     q_segment_ids: Optional[jax.Array] = None,
     kv_segment_ids: Optional[jax.Array] = None,
+    window: Optional[int] = None,
 ):
     """Flash attention over (B, S, H, D) tensors (layout matches the
     transformer layers in ``chainermn_tpu.models``).
+
+    ``window``: optional sliding-window size (Mistral-style local
+    attention, causal only): query ``i`` attends keys ``[i - window + 1,
+    i]``, intersected with the segment masks.  Whole tiles outside the
+    band are skipped in forward AND both backward kernels, so compute
+    scales O(S * window) instead of O(S²/2).
 
     Uses the Pallas kernel when shapes allow (D ≤ 256, S divisible by the
     block sizes after clamping); otherwise falls back to XLA attention.
@@ -646,6 +688,15 @@ def flash_attention(
         )
     if scale is None:
         scale = 1.0 / (D**0.5)
+    if window is not None:
+        if not causal:
+            raise ValueError(
+                "window (sliding-window attention) requires causal=True — "
+                "a non-causal local band has no in-tree consumer and "
+                "would silently differ from every oracle"
+            )
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if (q_segment_ids is None) != (kv_segment_ids is None):
         raise ValueError(
             "q_segment_ids and kv_segment_ids must be passed together"
@@ -695,6 +746,7 @@ def flash_attention(
         return _xla_attention(
             q, k, v, scale, causal,
             q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
+            window=window,
         )
 
     # (B, S, H, D) → (B*H, S, D); kv keep their own (possibly smaller)
@@ -707,11 +759,12 @@ def flash_attention(
         qs = seg_to_bh(q_segment_ids, H)
         ks = seg_to_bh(kv_segment_ids, Hk)
         out = _flash_bh_seg(
-            qt, kt, vt, qs, ks, scale, causal, block_q, block_k, interpret
+            qt, kt, vt, qs, ks, scale, causal, block_q, block_k, interpret,
+            window,
         )
     else:
         out = _flash_bh(
-            qt, kt, vt, scale, causal, block_q, block_k, interpret
+            qt, kt, vt, scale, causal, block_q, block_k, interpret, window
         )
     return out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
 
@@ -772,7 +825,7 @@ def seg_to_bh(ids, H: int):
 
 
 def make_flash_attention_fn(causal: bool = True, q_segment_ids=None,
-                            kv_segment_ids=None):
+                            kv_segment_ids=None, window=None):
     """Adapter for the transformer layers' ``attention_fn`` slot (mask
     argument ignored; causality is the kernel's).
 
@@ -816,6 +869,7 @@ def make_flash_attention_fn(causal: bool = True, q_segment_ids=None,
             )
         return flash_attention(
             q, k, v, causal=causal, q_segment_ids=qs, kv_segment_ids=ks,
+            window=window,
         )
 
     return fn

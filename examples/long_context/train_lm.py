@@ -72,6 +72,12 @@ def main(argv=None):
                    "(zigzag = load-balanced causal ring, half ring's FLOPs)")
     p.add_argument("--no-flash", action="store_true",
                    help="disable the Pallas flash kernel (sp=none only)")
+    p.add_argument("--window", type=int, default=None,
+                   help="sliding-window (local) attention size — the "
+                        "flash kernel skips whole tiles outside the "
+                        "band, O(S*window) compute; --sp none only (the "
+                        "ring/ulysses layers impose their own global "
+                        "causality)")
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
                    default="bfloat16")
     p.add_argument("--dp", type=int, default=None,
@@ -116,11 +122,19 @@ def main(argv=None):
         if args.packed else None
     )
 
+    if args.window is not None and (args.sp != "none" or args.no_flash):
+        raise SystemExit("--window needs the flash kernel: --sp none "
+                         "without --no-flash")
     if args.sp == "none":
         if args.packed:
-            attention_fn = make_flash_attention_fn(q_segment_ids=seg_row)
+            attention_fn = make_flash_attention_fn(
+                q_segment_ids=seg_row, window=args.window
+            )
         else:
-            attention_fn = None if args.no_flash else make_flash_attention_fn()
+            attention_fn = (
+                None if args.no_flash
+                else make_flash_attention_fn(window=args.window)
+            )
         sp_ways_eff = 1
     elif args.sp == "ring":
         attention_fn = make_ring_attention_fn("intra", segment_ids=seg_row)

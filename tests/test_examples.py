@@ -234,3 +234,26 @@ def test_long_context_vocab_tp_rejects_bad_config():
     )
     assert proc.returncode != 0
     assert "--sp" in proc.stderr
+
+
+@pytest.mark.slow
+def test_long_context_window_smoke():
+    """Sliding-window local attention (--window) through the flash
+    kernel on the single-chip path."""
+    _run(
+        "long_context/train_lm.py",
+        "--sp", "none", "--window", "64", "--seq-len", "256",
+        "--batchsize", "8", "--d-model", "32", "--n-heads", "4",
+        "--d-ff", "64", "--layers", "1", "--vocab", "64", "--epochs", "1",
+        "--steps-per-epoch", "4", "--dtype", "float32",
+    )
+
+
+@pytest.mark.slow
+def test_long_context_window_rejects_sp():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_EX, "long_context/train_lm.py"),
+         "--sp", "ring", "--window", "64"],
+        capture_output=True, text=True, timeout=120, env=subprocess_env(),
+    )
+    assert proc.returncode != 0 and "--window" in proc.stderr
