@@ -138,8 +138,10 @@ def _attn_kernel(
             # its masked scores, making exp(s - m_new) = 1 — zero those
             # entries so padding rows accumulate nothing.  (Causal-only
             # running blocks always have >= 1 valid entry per row; a
-            # windowed block admitted for its LATE rows can have fully-
-            # masked EARLY rows, so the window path needs this too.)
+            # low-k windowed block is admitted because the q block's
+            # EARLY rows still reach it, while its LATE rows — whose
+            # window starts later — can be fully masked on this, their
+            # first visited block, so the window path needs this too.)
             p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
 

@@ -31,6 +31,7 @@ def ulysses_attention(
     scale: Optional[float] = None,
     q_segment_ids: Optional[jax.Array] = None,
     kv_segment_ids: Optional[jax.Array] = None,
+    window: Optional[int] = None,
 ):
     """Sequence-parallel attention via head↔sequence all-to-all.
 
@@ -43,6 +44,12 @@ def ulysses_attention(
     chip) — or already-full (B, S_local * n) ids, used as-is (the
     adapter's closure-constant path, no collective).  Passed to the
     shared flash kernel's segment masks.
+
+    ``window``: optional sliding-window size.  Unique among the SP
+    layers, ulysses supports it EXACTLY: after the head all-to-all each
+    chip holds the full sequence, so the kernel's global causal band
+    applies unchanged (ring/zigzag would need cross-shard band
+    bookkeeping and deliberately reject it).
     """
     n = lax.axis_size(axis_name)
     B, S_loc, H, D = q.shape
@@ -101,13 +108,13 @@ def ulysses_attention(
 
     out = flash_attention(
         qh, kh, vh, causal=causal, scale=scale,
-        q_segment_ids=qs, kv_segment_ids=ks,
+        q_segment_ids=qs, kv_segment_ids=ks, window=window,
     )
     return to_seq(out.astype(q.dtype))
 
 
 def make_ulysses_attention_fn(axis_name: str, causal: bool = True,
-                              segment_ids=None):
+                              segment_ids=None, window=None):
     """Adapter matching the transformer layers' ``attention_fn`` slot.
     ``segment_ids``: optional row-uniform GLOBAL (S,) packed-sequence
     ids, sliced per shard at call time via the traced axis index."""
@@ -129,6 +136,7 @@ def make_ulysses_attention_fn(axis_name: str, causal: bool = True,
             )
         return ulysses_attention(
             q, k, v, axis_name, causal=causal, q_segment_ids=qs,
+            window=window,
         )
 
     return fn

@@ -75,9 +75,10 @@ def main(argv=None):
     p.add_argument("--window", type=int, default=None,
                    help="sliding-window (local) attention size — the "
                         "flash kernel skips whole tiles outside the "
-                        "band, O(S*window) compute; --sp none only (the "
-                        "ring/ulysses layers impose their own global "
-                        "causality)")
+                        "band, O(S*window) compute.  --sp none or "
+                        "ulysses (full sequence per chip after the head "
+                        "all-to-all, so the global band applies "
+                        "unchanged); ring/zigzag reject it")
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
                    default="bfloat16")
     p.add_argument("--dp", type=int, default=None,
@@ -122,9 +123,12 @@ def main(argv=None):
         if args.packed else None
     )
 
-    if args.window is not None and (args.sp != "none" or args.no_flash):
-        raise SystemExit("--window needs the flash kernel: --sp none "
-                         "without --no-flash")
+    if args.window is not None and (
+        args.sp not in ("none", "ulysses")
+        or (args.sp == "none" and args.no_flash)
+    ):
+        raise SystemExit("--window needs a full-sequence attention view: "
+                         "--sp none (without --no-flash) or --sp ulysses")
     if args.sp == "none":
         if args.packed:
             attention_fn = make_flash_attention_fn(
@@ -155,7 +159,7 @@ def main(argv=None):
         sp_ways_eff = sp_ways
     else:
         attention_fn = make_ulysses_attention_fn(
-            "intra", segment_ids=seg_row
+            "intra", segment_ids=seg_row, window=args.window
         )
         sp_ways_eff = sp_ways
     if args.sp != "none" and sp_ways == 1:

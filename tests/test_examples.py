@@ -250,7 +250,21 @@ def test_long_context_window_smoke():
 
 
 @pytest.mark.slow
-def test_long_context_window_rejects_sp():
+def test_long_context_window_ulysses_smoke():
+    """--window composes with --sp ulysses (full sequence per chip after
+    the head all-to-all)."""
+    _run(
+        "long_context/train_lm.py",
+        "--sp", "ulysses", "--dp", "2", "--window", "64",
+        "--seq-len", "256", "--batchsize", "8", "--d-model", "32",
+        "--n-heads", "4", "--d-ff", "64", "--layers", "1",
+        "--vocab", "64", "--epochs", "1", "--steps-per-epoch", "4",
+        "--dtype", "float32",
+    )
+
+
+@pytest.mark.slow
+def test_long_context_window_rejects_ring():
     proc = subprocess.run(
         [sys.executable, os.path.join(_EX, "long_context/train_lm.py"),
          "--sp", "ring", "--window", "64"],

@@ -633,3 +633,32 @@ def test_transformer_lm_gqa_matches_repeat_oracle():
     np.testing.assert_allclose(
         np.asarray(out_dense), np.asarray(out_flash), rtol=2e-3, atol=2e-3
     )
+
+
+def test_ulysses_window_matches_banded_oracle(seq_mesh):
+    """Sliding window through ulysses: the head all-to-all leaves the
+    full sequence local, so the kernel's global band applies exactly."""
+    q, k, v = make_qkv(S=32)
+    window = 10
+
+    def body(q, k, v):
+        return ulysses_attention(q, k, v, "intra", causal=True,
+                                 window=window)
+
+    out = jax.jit(shard_map(
+        body, mesh=seq_mesh,
+        in_specs=(P(None, "intra"),) * 3, out_specs=P(None, "intra"),
+        check_vma=False,
+    ))(q, k, v)
+
+    S = q.shape[1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (q.shape[-1] ** 0.5)
+    qp = jnp.arange(S)[:, None]
+    kp = jnp.arange(S)[None, :]
+    band = (qp >= kp) & (qp - kp < window)
+    logits = jnp.where(band[None, None], logits, -jnp.inf)
+    w = jax.nn.softmax(logits)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", w, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
