@@ -275,7 +275,9 @@ def bench_lm(comm, args):
     use_remat = args.lm_remat
     model = TransformerLM(
         **cfg, remat=use_remat,
-        attention_fn=make_flash_attention_fn(causal=True),
+        attention_fn=make_flash_attention_fn(
+            causal=True, window=args.lm_window
+        ),
     )
     rng = np.random.RandomState(0)
     tokens = jnp.asarray(
@@ -303,12 +305,21 @@ def bench_lm(comm, args):
     step = opt.make_train_step(loss_fn, donate=True)
 
     # MODEL FLOPs (the Megatron MFU convention — excludes remat
-    # recompute): 6 * n_params per token (2 fwd + 4 bwd) plus causal
-    # attention 6 * S * d per token per layer (QK^T + AV, halved by
-    # causality, backward 2x forward).
+    # recompute): 6 * n_params per token (2 fwd + 4 bwd) plus attention
+    # 12 * span_avg * d per token per layer (QK^T + AV = 4*span*d fwd,
+    # backward 2x forward), where span_avg is the MEAN number of keys a
+    # query attends: S/2 for full causal (the triangle), and
+    # W - W^2/(2S) for a width-W sliding window (early tokens see fewer
+    # than W keys; no triangle halving applies inside the band).  The
+    # full-causal case is exactly the W = S specialization.
+    if args.lm_window:
+        W = min(S, args.lm_window)
+        span_avg = W - W * W / (2.0 * S)
+    else:
+        span_avg = S / 2.0
     model_flops = B * S * (
         6.0 * n_params
-        + 6.0 * S * cfg["d_model"] * cfg["n_layers"]
+        + 12.0 * span_avg * cfg["d_model"] * cfg["n_layers"]
     )
     # EXECUTED FLOPs from XLA's cost model on the compiled step —
     # includes the remat recompute, so it measures hardware utilization
@@ -350,7 +361,7 @@ def bench_lm(comm, args):
         ),
         "params_millions": round(n_params / 1e6, 1),
         "config": {**cfg, "per_chip_batch": B, "remat": use_remat,
-                   "optimizer": "adamw"},
+                   "window": args.lm_window, "optimizer": "adamw"},
         "runs_tok_per_sec": [
             round(B * S / s, 1) for s in sorted(samples)
         ],
@@ -398,6 +409,10 @@ def main(argv=None):
     ap.add_argument("--lm-d-ff", type=int, default=8192)
     ap.add_argument("--lm-layers", type=int, default=8)
     ap.add_argument("--lm-ce-chunk", type=int, default=1024)
+    ap.add_argument("--lm-window", type=int, default=None,
+                    help="sliding-window attention size (the flash "
+                         "kernel skips tiles outside the band: O(S*W) "
+                         "attention — the long-context single-chip knob)")
     ap.add_argument("--lm-remat", action="store_true",
                     help="enable per-layer remat (less activation memory, "
                          "~1/3 extra forward FLOPs; lets --lm-batch grow)")
