@@ -117,6 +117,7 @@ def ring_attention(
     scale: Optional[float] = None,
     q_segment_ids: Optional[jax.Array] = None,
     kv_segment_ids: Optional[jax.Array] = None,
+    window: Optional[int] = None,
 ):
     """Sequence-parallel attention; call inside ``shard_map`` with the
     sequence dimension sharded over ``axis_name``.
@@ -129,6 +130,11 @@ def ring_attention(
     LOCAL shards of packed-sequence segment ids — the KV ids rotate
     around the ring with their K/V blocks, so attention never crosses a
     segment boundary even when the boundary crosses a shard boundary.
+    ``window``: optional sliding-window size (causal only) — the ring
+    already masks every rotated block by GLOBAL positions, so the band
+    ``q_pos - k_pos < window`` composes exactly even when it crosses
+    shard boundaries.  (Blocks wholly outside the band still rotate —
+    the uniform scan stays static — but contribute nothing.)
     Returns (B, S_local, H, D) attention output for the local queries,
     numerically identical (up to fp32 accumulation order) to full
     attention over the gathered sequence.
@@ -138,6 +144,11 @@ def ring_attention(
     B, S, H, D = q.shape
     if scale is None:
         scale = 1.0 / (D**0.5)
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if kv_segment_ids is not None and q_segment_ids is None:
         raise ValueError(
             "kv_segment_ids without q_segment_ids would be silently "
@@ -163,7 +174,10 @@ def ring_attention(
         src = (my - j) % n                   # originating rank of this block
         k_pos = src * S + jnp.arange(S)
         if causal:
-            mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+            mask = q_pos[:, None] >= k_pos[None, :]
+            if window is not None:
+                mask = mask & (q_pos[:, None] - k_pos[None, :] < window)
+            mask = mask[None, None]
         else:
             mask = None
         if segmented:
@@ -445,7 +459,7 @@ def _local_seg_slice(segment_ids, axis_name, s_local, batch):
 
 
 def make_ring_attention_fn(axis_name: str, causal: bool = True,
-                           segment_ids=None):
+                           segment_ids=None, window=None):
     """Adapter with the ``attention_fn(q, k, v, mask)`` signature the
     transformer layers accept (mask ignored: causality is positional).
     ``segment_ids``: optional row-uniform GLOBAL (S,) packed-sequence
@@ -461,7 +475,7 @@ def make_ring_attention_fn(axis_name: str, causal: bool = True,
             ks = qs
         return ring_attention(
             q, k, v, axis_name, causal=causal,
-            q_segment_ids=qs, kv_segment_ids=ks,
+            q_segment_ids=qs, kv_segment_ids=ks, window=window,
         )
 
     return fn

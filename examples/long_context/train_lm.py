@@ -73,12 +73,14 @@ def main(argv=None):
     p.add_argument("--no-flash", action="store_true",
                    help="disable the Pallas flash kernel (sp=none only)")
     p.add_argument("--window", type=int, default=None,
-                   help="sliding-window (local) attention size — the "
-                        "flash kernel skips whole tiles outside the "
-                        "band, O(S*window) compute.  --sp none or "
-                        "ulysses (full sequence per chip after the head "
-                        "all-to-all, so the global band applies "
-                        "unchanged); ring/zigzag reject it")
+                   help="sliding-window (local) attention size.  --sp "
+                        "none: the flash kernel skips whole tiles "
+                        "outside the band (O(S*window) compute); ring: "
+                        "the global-position block masks carry the band "
+                        "across shard boundaries; ulysses: full "
+                        "sequence per chip after the head all-to-all.  "
+                        "zigzag rejects it (its schedule derives from "
+                        "full causality)")
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
                    default="bfloat16")
     p.add_argument("--dp", type=int, default=None,
@@ -124,11 +126,14 @@ def main(argv=None):
     )
 
     if args.window is not None and (
-        args.sp not in ("none", "ulysses")
-        or (args.sp == "none" and args.no_flash)
+        args.sp == "zigzag" or (args.sp == "none" and args.no_flash)
     ):
-        raise SystemExit("--window needs a full-sequence attention view: "
-                         "--sp none (without --no-flash) or --sp ulysses")
+        raise SystemExit("--window: supported with --sp none (flash "
+                         "kernel band), ring (global-position band), or "
+                         "ulysses (full sequence after the head "
+                         "all-to-all); zigzag's chunk schedule is "
+                         "derived from FULL causality and would need "
+                         "its own banded block selection")
     if args.sp == "none":
         if args.packed:
             attention_fn = make_flash_attention_fn(
@@ -141,7 +146,9 @@ def main(argv=None):
             )
         sp_ways_eff = 1
     elif args.sp == "ring":
-        attention_fn = make_ring_attention_fn("intra", segment_ids=seg_row)
+        attention_fn = make_ring_attention_fn(
+            "intra", segment_ids=seg_row, window=args.window
+        )
         sp_ways_eff = sp_ways
     elif args.sp == "zigzag":
         from chainermn_tpu.parallel.ring_attention import (

@@ -264,10 +264,23 @@ def test_long_context_window_ulysses_smoke():
 
 
 @pytest.mark.slow
-def test_long_context_window_rejects_ring():
+def test_long_context_window_ring_smoke():
+    """--window across ring shard boundaries (global-position band)."""
+    _run(
+        "long_context/train_lm.py",
+        "--sp", "ring", "--dp", "2", "--window", "64",
+        "--seq-len", "256", "--batchsize", "8", "--d-model", "32",
+        "--n-heads", "4", "--d-ff", "64", "--layers", "1",
+        "--vocab", "64", "--epochs", "1", "--steps-per-epoch", "4",
+        "--dtype", "float32",
+    )
+
+
+@pytest.mark.slow
+def test_long_context_window_rejects_zigzag():
     proc = subprocess.run(
         [sys.executable, os.path.join(_EX, "long_context/train_lm.py"),
-         "--sp", "ring", "--window", "64"],
+         "--sp", "zigzag", "--window", "64"],
         capture_output=True, text=True, timeout=120, env=subprocess_env(),
     )
     assert proc.returncode != 0 and "--window" in proc.stderr
