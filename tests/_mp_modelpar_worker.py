@@ -179,6 +179,15 @@ def main():
     )
 
     B, S, H, Dh = 2, 8 * nproc, 2, 4
+
+    def dense_causal_ref(q, k, v):
+        logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(Dh)
+        mask = np.tril(np.ones((q.shape[1],) * 2, bool))
+        logits = np.where(mask[None, None], logits, -np.inf)
+        w = np.exp(logits - logits.max(-1, keepdims=True))
+        w = w / w.sum(-1, keepdims=True)
+        return np.einsum("bhqk,bkhd->bqhd", w, v)
+
     q = rng.randn(B, S, H, Dh).astype(np.float32)
     k = rng.randn(B, S, H, Dh).astype(np.float32)
     vv = rng.randn(B, S, H, Dh).astype(np.float32)
@@ -194,12 +203,7 @@ def main():
     ))(put(P(None, "inter"), q[:, idx]), put(P(None, "inter"), k[:, idx]),
        put(P(None, "inter"), vv[:, idx]))
 
-    logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(Dh)
-    mask = np.tril(np.ones((S, S), bool))
-    logits = np.where(mask[None, None], logits, -np.inf)
-    w = np.exp(logits - logits.max(-1, keepdims=True))
-    w = w / w.sum(-1, keepdims=True)
-    ref = np.einsum("bhqk,bkhd->bqhd", w, vv)
+    ref = dense_causal_ref(q, k, vv)
     got = np.zeros_like(ref)
     # Reassemble only the shards THIS process holds; verify those rows.
     for shard in out.addressable_shards:
@@ -210,6 +214,31 @@ def main():
             np.asarray(shard.data), ref[:, zz_rows], rtol=2e-4, atol=2e-4
         )
     del got, inv
+
+    # ---- 3b. Ulysses SP over the process boundary: the head<->sequence
+    # all-to-all crosses processes; GQA deals the reduced kv heads too.
+    from chainermn_tpu.parallel.ulysses import ulysses_attention
+
+    Hq, Hkv = 2 * nproc, nproc  # both divisible by the axis size
+    uq = rng.randn(B, S, Hq, Dh).astype(np.float32)
+    uk = rng.randn(B, S, Hkv, Dh).astype(np.float32)
+    uv = rng.randn(B, S, Hkv, Dh).astype(np.float32)
+
+    u_out = jax.jit(comm.shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, "inter", causal=True),
+        in_specs=(P(None, "inter"),) * 3, out_specs=P(None, "inter"),
+    ))(put(P(None, "inter"), uq), put(P(None, "inter"), uk),
+       put(P(None, "inter"), uv))
+
+    G = Hq // Hkv
+    uref = dense_causal_ref(
+        uq, np.repeat(uk, G, axis=2), np.repeat(uv, G, axis=2)
+    )
+    for shard in u_out.addressable_shards:
+        sl = shard.index[1]
+        np.testing.assert_allclose(
+            np.asarray(shard.data), uref[:, sl], rtol=2e-4, atol=2e-4
+        )
 
     # ---- 4. MoE with the token all-to-all over the process boundary:
     # one expert per inter row, shard-wise oracle per device row.
