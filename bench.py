@@ -51,7 +51,7 @@ import numpy as np
 import optax
 
 import chainermn_tpu
-from chainermn_tpu.utils.profiling import slope_time, sync
+from chainermn_tpu.utils.profiling import median_slope, sync
 
 REFERENCE_IMAGES_PER_SEC_PER_CHIP = 125.0  # P100, ChainerMN pure_nccl era
 V5E_BF16_PEAK = 197e12  # TPU v5e paper peak, bf16 FLOP/s/chip
@@ -85,15 +85,6 @@ def _compiled_flops_per_device(lowerable, *args, fallback):
         return float(ca["flops"])
     except Exception:
         return fallback
-
-
-def _median_slope(run, n1=5, repeats=3):
-    """Median of >= 3 independent slope measurements with the spread —
-    the tunneled chip shows real run-to-run variance (r2 2742 vs r3 2536
-    img/s was indistinguishable from tunnel noise without it), so one
-    sample is not a number."""
-    samples = sorted(slope_time(run, n1) for _ in range(repeats))
-    return samples[len(samples) // 2], samples
 
 
 def bench_resnet(comm, args):
@@ -223,7 +214,7 @@ def bench_resnet(comm, args):
         sync(loss)
         return time.perf_counter() - t0
 
-    step_time, samples = _median_slope(run)
+    step_time, samples = median_slope(run)
     ips_samples = sorted(
         (per_chip_batch / s for s in samples), reverse=True
     )
@@ -273,10 +264,41 @@ def bench_lm(comm, args):
         n_layers=args.lm_layers, max_len=S,
     )
     use_remat = args.lm_remat
+
+    # --autotune: search the Pallas block spaces for THIS step's shapes
+    # (persisting winners in the tune cache), then pin the chosen configs
+    # explicitly so the measured run uses exactly what the tuner picked.
+    fa_kwargs = {}
+    ce_chunk = args.lm_ce_chunk
+    autotune_rec = None
+    if args.autotune:
+        from chainermn_tpu.tuning import cache_path, tune_lm_shapes
+
+        tuned = tune_lm_shapes(
+            batch=B, seq=S, n_heads=cfg["n_heads"],
+            d_model=cfg["d_model"], vocab=cfg["vocab"],
+            window=args.lm_window,
+        )
+        fwd = tuned["flash"].get("fwd", {}).get("chosen")
+        bwd = tuned["flash"].get("bwd", {}).get("chosen")
+        if fwd:
+            fa_kwargs.update(block_q=fwd["block_q"],
+                             block_k=fwd["block_k"])
+        if bwd:
+            fa_kwargs.update(block_q_bwd=bwd["block_q"],
+                             block_k_bwd=bwd["block_k"])
+        ce = tuned["fused_ce"].get("chosen")
+        if ce:
+            ce_chunk = ce["chunk"]
+        autotune_rec = {
+            "flash_fwd": fwd, "flash_bwd": bwd, "fused_ce": ce,
+            "cache_path": cache_path(),
+        }
+
     model = TransformerLM(
         **cfg, remat=use_remat,
         attention_fn=make_flash_attention_fn(
-            causal=True, window=args.lm_window
+            causal=True, window=args.lm_window, **fa_kwargs
         ),
     )
     rng = np.random.RandomState(0)
@@ -299,7 +321,7 @@ def bench_lm(comm, args):
         toks, labs = batch
         h = model.apply({"params": p}, toks, return_hidden=True)
         return fused_cross_entropy(
-            h, p["embed"]["embedding"], labs, chunk=args.lm_ce_chunk
+            h, p["embed"]["embedding"], labs, chunk=ce_chunk
         )
 
     step = opt.make_train_step(loss_fn, donate=True)
@@ -308,15 +330,16 @@ def bench_lm(comm, args):
     # recompute): 6 * n_params per token (2 fwd + 4 bwd) plus attention
     # 12 * span_avg * d per token per layer (QK^T + AV = 4*span*d fwd,
     # backward 2x forward), where span_avg is the MEAN number of keys a
-    # query attends: S/2 for full causal (the triangle), and
-    # W - W^2/(2S) for a width-W sliding window (early tokens see fewer
-    # than W keys; no triangle halving applies inside the band).  The
-    # full-causal case is exactly the W = S specialization.
+    # query attends (each query sees min(i+1, W) keys, self inclusive):
+    # mean over i of i+1 = (S+1)/2 for full causal, and exactly
+    # W - W(W-1)/(2S) for a width-W sliding window (the first W-1
+    # queries see fewer than W keys; summing the ramp gives the W(W-1)/2
+    # deficit).  Full causal is exactly the W = S specialization.
     if args.lm_window:
         W = min(S, args.lm_window)
-        span_avg = W - W * W / (2.0 * S)
+        span_avg = W - W * (W - 1) / (2.0 * S)
     else:
-        span_avg = S / 2.0
+        span_avg = (S + 1) / 2.0
     model_flops = B * S * (
         6.0 * n_params
         + 12.0 * span_avg * cfg["d_model"] * cfg["n_layers"]
@@ -341,11 +364,11 @@ def bench_lm(comm, args):
         sync(loss)
         return time.perf_counter() - t0
 
-    step_time, samples = _median_slope(run)
+    step_time, samples = median_slope(run)
     tok_per_chip = B * S / step_time
     mfu = model_flops / step_time / V5E_BF16_PEAK
     hw_util = step_flops_per_dev / step_time / V5E_BF16_PEAK
-    return {
+    result = {
         "metric": "tokens/sec/chip decoder-LM train step "
                   "(flash attention + fused CE"
                   + (" + remat" if use_remat else "") + ", AdamW)",
@@ -369,6 +392,9 @@ def bench_lm(comm, args):
             100.0 * (max(samples) - min(samples)) / min(samples), 1
         ),
     }
+    if autotune_rec is not None:
+        result["autotune"] = autotune_rec
+    return result
 
 
 def main(argv=None):
@@ -416,6 +442,12 @@ def main(argv=None):
     ap.add_argument("--lm-remat", action="store_true",
                     help="enable per-layer remat (less activation memory, "
                          "~1/3 extra forward FLOPs; lets --lm-batch grow)")
+    ap.add_argument("--autotune", action="store_true",
+                    help="search the Pallas block configs for the LM "
+                         "step's shapes first (persisting winners in the "
+                         "tune cache), then bench with the chosen configs "
+                         "pinned; the chosen (block_q, block_k, chunk) "
+                         "land under the LM result's \"autotune\" key")
     args = ap.parse_args(argv)
     comm = chainermn_tpu.create_communicator("xla_ici")
 

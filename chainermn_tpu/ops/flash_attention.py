@@ -430,12 +430,16 @@ def _flash_bh_bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def _flash_bh(q, k, v, scale, causal, block_q, block_k, interpret,
-              window=None):
+              window=None, block_q_bwd=None, block_k_bwd=None):
     """(BH, S, D) flash attention, differentiable (FlashAttention-2-style
     explicit backward: recompute probabilities blockwise from the saved row
-    LSE, never materializing the S×S matrix in either pass)."""
+    LSE, never materializing the S×S matrix in either pass).
+
+    ``block_q_bwd``/``block_k_bwd``: optional separate geometry for the
+    backward kernels (their tile economics differ — two extra streamed
+    operands, two kernels); None means reuse the forward blocks."""
     o, _ = _flash_bh_fwd(
         q, k, v, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret,
@@ -445,7 +449,7 @@ def _flash_bh(q, k, v, scale, causal, block_q, block_k, interpret,
 
 
 def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
-                   window=None):
+                   window=None, block_q_bwd=None, block_k_bwd=None):
     o, lse = _flash_bh_fwd(
         q, k, v, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret,
@@ -453,13 +457,13 @@ def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
     )
     return o, (q, k, v, o, lse)
 
-def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, window, res,
-                   do):
+def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, window,
+                   block_q_bwd, block_k_bwd, res, do):
     q, k, v, o, lse = res
     dq, dk, dv = _flash_bh_bwd(
         q, k, v, o, lse, do, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, interpret=interpret,
-        window=window,
+        block_q=block_q_bwd or block_q, block_k=block_k_bwd or block_k,
+        interpret=interpret, window=window,
     )
     return dq, dk, dv
 
@@ -472,13 +476,16 @@ def _float0_like(x):
     return np.zeros(x.shape, dtype=jax.dtypes.float0)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12))
 def _flash_bh_seg(q, k, v, q_seg, kv_seg, scale, causal, block_q, block_k,
-                  interpret, window=None):
+                  interpret, window=None, block_q_bwd=None,
+                  block_k_bwd=None):
     """Segment-masked (BH, S, D) flash attention (packed sequences):
     tokens attend only within their own segment id.  Same explicit
-    FlashAttention-2 backward; fully-masked (padding) rows produce zero
-    output and zero gradients."""
+    FlashAttention-2 backward (with its own optional block geometry, see
+    :func:`_flash_bh`); fully-masked (padding) rows produce zero output
+    and zero gradients."""
     o, _ = _flash_bh_fwd(
         q, k, v, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret,
@@ -488,7 +495,8 @@ def _flash_bh_seg(q, k, v, q_seg, kv_seg, scale, causal, block_q, block_k,
 
 
 def _flash_seg_vjp_fwd(q, k, v, q_seg, kv_seg, scale, causal, block_q,
-                       block_k, interpret, window=None):
+                       block_k, interpret, window=None, block_q_bwd=None,
+                       block_k_bwd=None):
     o, lse = _flash_bh_fwd(
         q, k, v, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret,
@@ -498,12 +506,12 @@ def _flash_seg_vjp_fwd(q, k, v, q_seg, kv_seg, scale, causal, block_q,
 
 
 def _flash_seg_vjp_bwd(scale, causal, block_q, block_k, interpret, window,
-                       res, do):
+                       block_q_bwd, block_k_bwd, res, do):
     q, k, v, o, lse, q_seg, kv_seg = res
     dq, dk, dv = _flash_bh_bwd(
         q, k, v, o, lse, do, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, interpret=interpret,
-        q_seg=q_seg, kv_seg=kv_seg, window=window,
+        block_q=block_q_bwd or block_q, block_k=block_k_bwd or block_k,
+        interpret=interpret, q_seg=q_seg, kv_seg=kv_seg, window=window,
     )
     return dq, dk, dv, _float0_like(q_seg), _float0_like(kv_seg)
 
@@ -633,6 +641,21 @@ def _xla_attention(q, k, v, scale, causal, q_segment_ids=None,
     return jnp.einsum("bhqk,bkhd->bqhd", w, v.astype(jnp.float32)).astype(q.dtype)
 
 
+def auto_block_size(S: int) -> int:
+    """The STATIC default block edge: largest-coverage choice near S/16
+    that both divides S and meets the sublane alignment (128/256/512 are
+    multiples of every sublane count) — a poor auto pick must not
+    silently demote a previously-compiling shape to the XLA fallback.
+    This is also the fallback the tuning subsystem resolves to on a
+    cache miss, and a mandatory member of its search space (a tuned pick
+    can never lose to it)."""
+    target = int(np.clip(S // 16, 128, 512))
+    cands = [b for b in (128, 256, 512) if S % b == 0]
+    if not cands:
+        return min(128, S)
+    return min(cands, key=lambda b: abs(b - target))
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -645,6 +668,8 @@ def flash_attention(
     q_segment_ids: Optional[jax.Array] = None,
     kv_segment_ids: Optional[jax.Array] = None,
     window: Optional[int] = None,
+    block_q_bwd: Optional[int] = None,
+    block_k_bwd: Optional[int] = None,
 ):
     """Flash attention over (B, S, H, D) tensors (layout matches the
     transformer layers in ``chainermn_tpu.models``).
@@ -674,11 +699,22 @@ def flash_attention(
     segment id -1 against all-nonnegative kv ids) produce zero output
     and zero gradients.
 
-    ``block_q``/``block_k`` default to an auto size, ``S/16`` clamped to
-    [128, 512] — measured optimal per length on a v5e-class chip
-    (S=2048→128, 4096→256, 8192→512; at 8192/bf16/D=128 the kernel
+    ``block_q``/``block_k`` default to a TUNED size when the persistent
+    autotune cache (``chainermn_tpu.tuning``, see docs/tuning.md) holds a
+    measured-best entry for this (device kind, dtype, shape bucket,
+    causal/window) — populated by ``python -m chainermn_tpu.tools
+    .autotune`` or ``bench.py --autotune``, never implicitly.  On a miss,
+    off-TPU, or under pytest, the static auto size applies: ``S/16``
+    clamped to [128, 512] — measured optimal per length on a v5e-class
+    chip (S=2048→128, 4096→256, 8192→512; at 8192/bf16/D=128 the kernel
     sustains ~67 TFLOP/s forward, 4.5-4.9x XLA's materialized-logits
-    attention, slope-timed per docs/performance.md).
+    attention, slope-timed per docs/performance.md).  Pinning either
+    block explicitly bypasses the cache entirely.
+
+    ``block_q_bwd``/``block_k_bwd``: optional separate geometry for the
+    backward kernels (tuned independently — the backward streams two
+    extra operands and runs two kernels, so its optimum can differ);
+    default to the forward blocks (tuned or static).
     """
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
@@ -707,21 +743,31 @@ def flash_attention(
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
 
-    def _auto_block(S):
-        # Largest-coverage choice near S/16 that both divides S and meets
-        # the sublane alignment (128/256/512 are multiples of every
-        # sublane count) — a poor auto pick must not silently demote a
-        # previously-compiling shape to the XLA fallback.
-        target = int(np.clip(S // 16, 128, 512))
-        cands = [b for b in (128, 256, 512) if S % b == 0]
-        if not cands:
-            return min(128, S)
-        return min(cands, key=lambda b: abs(b - target))
+    segmented = q_segment_ids is not None
+    if block_q is None and block_k is None and not interpret:
+        # Caller pinned nothing: consult the persistent tune cache (a
+        # trace-time read; inert under pytest and off-TPU, so interpret/
+        # CPU behavior stays bit-identical to the static defaults).
+        from chainermn_tpu.tuning.autotune import lookup_flash_blocks
+
+        tuned = lookup_flash_blocks(
+            "fwd", Sq=Sq, Sk=Sk, D=D, dtype=q.dtype, causal=causal,
+            window=window, segmented=segmented,
+        )
+        if tuned is not None:
+            block_q, block_k = tuned
+        if block_q_bwd is None and block_k_bwd is None:
+            tuned_bwd = lookup_flash_blocks(
+                "bwd", Sq=Sq, Sk=Sk, D=D, dtype=q.dtype, causal=causal,
+                window=window, segmented=segmented,
+            )
+            if tuned_bwd is not None:
+                block_q_bwd, block_k_bwd = tuned_bwd
 
     if block_q is None:
-        block_q = _auto_block(Sq)
+        block_q = auto_block_size(Sq)
     if block_k is None:
-        block_k = _auto_block(Sk)
+        block_k = auto_block_size(Sk)
     block_q = min(block_q, Sq)
     block_k = min(block_k, Sk)
     # Sublane tiling constraint on compiled TPU kernels: the block's
@@ -751,6 +797,18 @@ def flash_attention(
             window=window,
         )
 
+    # Backward geometry rides the same gate as the forward's: an invalid
+    # pair (stale cache bucket, caller typo) silently reverts to the
+    # forward blocks rather than demoting the whole call to the XLA path.
+    if block_q_bwd is not None or block_k_bwd is not None:
+        bq_b = block_q_bwd or block_q
+        bk_b = block_k_bwd or block_k
+        bwd_ok = (
+            Sq % bq_b == 0 and Sk % bk_b == 0
+            and (interpret or (bq_b % sublane == 0 and bk_b % sublane == 0))
+        )
+        block_q_bwd, block_k_bwd = (bq_b, bk_b) if bwd_ok else (None, None)
+
     # (B, S, H, D) → (B*H, S, D); kv keep their own (possibly smaller)
     # head count — the batch-major flattening makes q row b's kv row
     # exactly b // (H // Hk) (see _kv_group).
@@ -762,11 +820,12 @@ def flash_attention(
         ks = seg_to_bh(kv_segment_ids, Hk)
         out = _flash_bh_seg(
             qt, kt, vt, qs, ks, scale, causal, block_q, block_k, interpret,
-            window,
+            window, block_q_bwd, block_k_bwd,
         )
     else:
         out = _flash_bh(
-            qt, kt, vt, scale, causal, block_q, block_k, interpret, window
+            qt, kt, vt, scale, causal, block_q, block_k, interpret, window,
+            block_q_bwd, block_k_bwd,
         )
     return out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
 
@@ -798,10 +857,8 @@ def flash_block_plan(S: int, D: int, dtype, interpret: bool):
         return True, b
     if D > 256:
         return False, 0
-    target = int(np.clip(S // 16, 128, 512))
-    cands = [b for b in (128, 256, 512) if S % b == 0]
-    if cands:
-        return True, min(cands, key=lambda b: abs(b - target))
+    if any(S % b == 0 for b in (128, 256, 512)):
+        return True, auto_block_size(S)
     sublane = 16 if dtype == jnp.bfloat16 else 8
     if S <= 512 and S % sublane == 0:
         return True, S
@@ -827,9 +884,16 @@ def seg_to_bh(ids, H: int):
 
 
 def make_flash_attention_fn(causal: bool = True, q_segment_ids=None,
-                            kv_segment_ids=None, window=None):
+                            kv_segment_ids=None, window=None,
+                            block_q=None, block_k=None,
+                            block_q_bwd=None, block_k_bwd=None):
     """Adapter for the transformer layers' ``attention_fn`` slot (mask
     argument ignored; causality is the kernel's).
+
+    ``block_q``/``block_k``/``block_q_bwd``/``block_k_bwd``: optional
+    pinned kernel geometry (``bench.py --autotune`` binds the tuned
+    blocks here); None defers to :func:`flash_attention`'s cache-then-
+    static default.
 
     ``q_segment_ids``/``kv_segment_ids`` (optional int32) bind
     packed-sequence segment masks at CONSTRUCTION — the layers call
@@ -871,7 +935,8 @@ def make_flash_attention_fn(causal: bool = True, q_segment_ids=None,
             )
         return flash_attention(
             q, k, v, causal=causal, q_segment_ids=qs, kv_segment_ids=ks,
-            window=window,
+            window=window, block_q=block_q, block_k=block_k,
+            block_q_bwd=block_q_bwd, block_k_bwd=block_k_bwd,
         )
 
     return fn

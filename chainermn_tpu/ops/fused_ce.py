@@ -32,6 +32,26 @@ import functools
 import jax
 import jax.numpy as jnp
 
+#: the static default row chunk — the cache-miss / off-TPU fallback, and
+#: a mandatory member of the autotuner's search space (a tuned chunk can
+#: never lose to it).
+DEFAULT_CHUNK = 512
+
+
+def _resolve_chunk(chunk, N: int, V: int, D: int, dtype) -> int:
+    """``chunk=None`` → the tuned chunk from the persistent autotune
+    cache (``chainermn_tpu.tuning``; populated only by the explicit CLI /
+    ``bench.py --autotune``), falling back to :data:`DEFAULT_CHUNK` on a
+    miss.  Inert under pytest and off-TPU — there None always resolves
+    to the static default, bit-identical to the pre-tuning behavior.
+    An explicit ``chunk`` bypasses the cache."""
+    if chunk is not None:
+        return int(chunk)
+    from chainermn_tpu.tuning.autotune import lookup_ce_chunk
+
+    tuned = lookup_ce_chunk(N=N, V=V, D=D, dtype=dtype)
+    return int(tuned) if tuned else DEFAULT_CHUNK
+
 
 def _pick_chunk(n: int, chunk: int) -> int:
     """Largest divisor of ``n`` that is <= chunk (scan needs equal-size
@@ -198,7 +218,7 @@ def _fused_ce_vjp_bwd(chunk, res, cots):
 _fused_ce_sum.defvjp(_fused_ce_vjp_fwd, _fused_ce_vjp_bwd)
 
 
-def fused_cross_entropy(hidden, embedding, labels, *, chunk: int = 512):
+def fused_cross_entropy(hidden, embedding, labels, *, chunk=None):
     """Mean softmax cross-entropy of ``hidden @ embedding.T`` against
     ``labels``, computed without materializing the ``(N, V)`` logit
     matrix (peak extra memory ``chunk x V`` fp32).
@@ -215,24 +235,35 @@ def fused_cross_entropy(hidden, embedding, labels, *, chunk: int = 512):
     Differentiable in ``hidden`` and ``embedding``; the backward pass
     recomputes each chunk's logits from a saved per-token log-sum-exp
     (4 bytes/token) instead of storing them.
+
+    ``chunk`` — rows per scan tile.  The default (None) resolves to the
+    autotuned chunk for this (device kind, dtype, N, V, D) when the
+    persistent tune cache has one (see docs/tuning.md), else the static
+    :data:`DEFAULT_CHUNK` — always the static default off-TPU and under
+    pytest.  Passing an int pins it.
     """
     h2, l2 = _validate_and_flatten(hidden, embedding, labels, chunk)
-    loss_sum, n_valid, _lse = _fused_ce_sum(h2, embedding, l2, int(chunk))
+    chunk = _resolve_chunk(
+        chunk, h2.shape[0], embedding.shape[0], h2.shape[1], hidden.dtype
+    )
+    loss_sum, n_valid, _lse = _fused_ce_sum(h2, embedding, l2, chunk)
     return loss_sum / jnp.maximum(n_valid, 1.0)
 
 
-def fused_cross_entropy_with_lse(hidden, embedding, labels, *,
-                                 chunk: int = 512):
+def fused_cross_entropy_with_lse(hidden, embedding, labels, *, chunk=None):
     """:func:`fused_cross_entropy` variant also returning the per-token
     log-sum-exp ``(N,)`` — the z-loss / logit-scale diagnostic, and the
     merge quantity for vocab-sharded composition."""
     h2, l2 = _validate_and_flatten(hidden, embedding, labels, chunk)
-    loss_sum, n_valid, lse = _fused_ce_sum(h2, embedding, l2, int(chunk))
+    chunk = _resolve_chunk(
+        chunk, h2.shape[0], embedding.shape[0], h2.shape[1], hidden.dtype
+    )
+    loss_sum, n_valid, lse = _fused_ce_sum(h2, embedding, l2, chunk)
     return loss_sum / jnp.maximum(n_valid, 1.0), lse
 
 
 def _validate_and_flatten(hidden, embedding, labels, chunk):
-    if int(chunk) < 1:
+    if chunk is not None and int(chunk) < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
     D = hidden.shape[-1]
     h2 = hidden.reshape(-1, D)

@@ -16,11 +16,22 @@ weighted 0), so the program is retrace-free regardless of routing skew.
 
 from __future__ import annotations
 
+import warnings
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+def _axis_size(axis_name) -> int:
+    """Static mapped-axis size across jax versions: ``lax.axis_size`` where
+    it exists; on older jax ``core.axis_frame(name)`` IS the size."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    from jax import core
+
+    return core.axis_frame(axis_name)
 
 
 def topk_route(gate_logits: jax.Array, n_experts: int, capacity: int,
@@ -110,7 +121,7 @@ def moe_layer(
     axis_name: str,
     capacity_factor: float = 2.0,
     k: int = 1,
-    return_aux: bool = False,
+    return_aux: bool | str = False,
     experts_per_device: int = 1,
 ):
     """Expert-parallel MoE FFN; call inside ``shard_map`` over ``axis_name``.
@@ -133,10 +144,18 @@ def moe_layer(
       routings NOT granted a capacity slot (passed through as zeros);
       the router-health gauge capacity_factor should be tuned against.
 
+    .. note:: **Changed contract.** ``return_aux=True`` used to return
+       ``(y, scalar_load_balance_loss)``; it now returns ``(y, dict)``
+       as documented above.  Callers still expecting the bare scalar can
+       pass ``return_aux="scalar"`` for one release — it returns the old
+       ``(y, load_balance_loss)`` pair and emits a
+       :class:`DeprecationWarning`.  The shim will be removed; switch to
+       ``return_aux=True`` and read ``aux["load_balance_loss"]``.
+
     Returns (T_local, D) with each token replaced by its experts' outputs
     weighted by the gates (dropped-by-capacity tokens pass through as
     zeros, as in Switch)."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     epd = experts_per_device
     if epd < 1:
         raise ValueError(f"experts_per_device must be >= 1, got {epd}")
@@ -193,6 +212,17 @@ def moe_layer(
             # either way).
             "dropped_fraction": 1.0 - jnp.sum(dispatch) / (k * T),
         }
+        if return_aux == "scalar":
+            # One-release back-compat shim for the (y, scalar) contract.
+            warnings.warn(
+                "moe_layer(return_aux='scalar') is deprecated: "
+                "return_aux=True now returns (y, aux_dict); read "
+                "aux['load_balance_loss'] instead.  The 'scalar' shim "
+                "will be removed next release.",
+                DeprecationWarning,
+                stacklevel=2,
+            )
+            return y, aux["load_balance_loss"]
         return y, aux
     return y
 

@@ -115,30 +115,60 @@ def make_gspmd_train_step(
     batch_sharding = NamedSharding(mesh, P(data_axis))
 
     # Optimizer moments (adam's mu/nu etc.) are param-shaped; shard them
-    # like their parameter so TP actually divides optimizer memory.  Shape
-    # lookup is the association mechanism (first match wins on shape
-    # collisions — all same-shape transformer params shard identically
-    # under these rules, so collisions are benign).
-    shape_to_sharding = {}
+    # like their parameter so TP actually divides optimizer memory.  The
+    # association mechanism is the TREE PATH: optax state leaves carry
+    # their parameter's path as a suffix (e.g. ('0', 'mu', *param_path)),
+    # so the longest path suffix that names a same-shaped parameter wins.
+    # Shape alone is only a fallback, and only when it's unambiguous —
+    # two same-shape params with DIFFERENT shardings (a fused-QKV weight
+    # sharded on heads next to an FFN weight sharded on d_ff, say) must
+    # not first-match-wins onto each other; such a leaf stays replicated.
+
+    def _path_key(path):
+        keys = []
+        for entry in path:
+            if hasattr(entry, "key"):
+                keys.append(str(entry.key))
+            elif hasattr(entry, "name"):
+                keys.append(str(entry.name))
+            elif hasattr(entry, "idx"):
+                keys.append(str(entry.idx))
+            else:
+                keys.append(str(entry))
+        return tuple(keys)
 
     def shard_fn(params, opt_state):
-        for p_leaf, s_leaf in zip(
-            jax.tree.leaves(params),
-            jax.tree.leaves(
-                param_shardings,
-                is_leaf=lambda x: isinstance(x, NamedSharding),
-            ),
-        ):
-            shape_to_sharding.setdefault(p_leaf.shape, s_leaf)
+        path_to_sharding = {}
+        shape_to_shardings = {}
+        param_leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+        sharding_leaves = jax.tree.leaves(
+            param_shardings,
+            is_leaf=lambda x: isinstance(x, NamedSharding),
+        )
+        for (p_path, p_leaf), s_leaf in zip(param_leaves, sharding_leaves):
+            path_to_sharding[_path_key(p_path)] = (p_leaf.shape, s_leaf)
+            shape_to_shardings.setdefault(p_leaf.shape, []).append(s_leaf)
         params = jax.device_put(params, param_shardings)
+        replicated = NamedSharding(mesh, P())
 
-        def opt_shard(x):
-            sharding = shape_to_sharding.get(
-                getattr(x, "shape", None), NamedSharding(mesh, P())
-            )
-            return jax.device_put(x, sharding)
+        def opt_shard(path, x):
+            shape = getattr(x, "shape", None)
+            key = _path_key(path)
+            # Longest matching suffix first: the full param path beats
+            # any accidental tail collision.
+            for i in range(len(key)):
+                hit = path_to_sharding.get(key[i:])
+                if hit is not None and hit[0] == shape:
+                    return jax.device_put(x, hit[1])
+            # Shape fallback for leaves whose path embeds no param path
+            # (scalar counts keep shape () and land replicated anyway) —
+            # honored only when every same-shape param agrees.
+            candidates = shape_to_shardings.get(shape, [])
+            if candidates and all(s == candidates[0] for s in candidates):
+                return jax.device_put(x, candidates[0])
+            return jax.device_put(x, replicated)
 
-        opt_state = jax.tree.map(opt_shard, opt_state)
+        opt_state = jax.tree_util.tree_map_with_path(opt_shard, opt_state)
         return params, opt_state
 
     jitted = jax.jit(

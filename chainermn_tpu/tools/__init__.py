@@ -1,0 +1,7 @@
+"""Operational command-line tools (``python -m chainermn_tpu.tools.*``).
+
+Currently: :mod:`~chainermn_tpu.tools.autotune` — pre-populate the
+persistent kernel tune cache for the bench shapes (or any shape family)
+so training runs pick up measured-best Pallas block configs instead of
+the static defaults.
+"""

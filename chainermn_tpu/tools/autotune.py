@@ -1,0 +1,103 @@
+"""Offline kernel autotuner CLI.
+
+Searches the block-config spaces of the Pallas hot paths (flash
+attention fwd/bwd ``block_q``×``block_k``, fused cross-entropy
+``chunk``) for a shape family — by default the LM bench shapes — and
+persists the measured-best configs in the JSON tune cache that
+``flash_attention`` / ``fused_cross_entropy`` consult at trace time
+(see ``docs/tuning.md``).
+
+Usage::
+
+    # enumerate the search spaces, no compilation or timing:
+    python -m chainermn_tpu.tools.autotune --dry-run
+
+    # tune the default bench shapes on the attached TPU and write the
+    # cache (CHAINERMN_TPU_TUNE_CACHE or /tmp/chainermn_tpu/...):
+    python -m chainermn_tpu.tools.autotune
+
+    # a custom shape family:
+    python -m chainermn_tpu.tools.autotune --seq 8192 --window 1024
+
+Prints one JSON line per tuned kernel (the same records ``bench.py
+--autotune`` embeds in its output).  Exit code 2 when asked to time
+kernels without a TPU backend (``--allow-cpu`` overrides, for harness
+debugging only — CPU timings must never steer TPU configs, which is why
+the cache key carries the device kind).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m chainermn_tpu.tools.autotune",
+        description="Search + persist best Pallas kernel configs.",
+    )
+    ap.add_argument("--dry-run", action="store_true",
+                    help="enumerate candidate configs only — no "
+                         "compilation, no timing, no cache writes")
+    ap.add_argument("--force", action="store_true",
+                    help="re-measure even when the cache already holds "
+                         "an entry for a key")
+    ap.add_argument("--cache-path", default=None,
+                    help="tune cache file (default: "
+                         "$CHAINERMN_TPU_TUNE_CACHE or the /tmp default)")
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="median-of-k slope samples per candidate")
+    ap.add_argument("--n1", type=int, default=3,
+                    help="base iteration count for the timing slope")
+    ap.add_argument("--allow-cpu", action="store_true",
+                    help="permit timing on a non-TPU backend (debugging "
+                         "the harness only)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress per-candidate progress on stderr")
+    # Shape family — defaults mirror bench.py's LM flagship.
+    ap.add_argument("--batch", type=int, default=4,
+                    help="sequences per chip (bench --lm-batch)")
+    ap.add_argument("--seq", type=int, default=4096)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--d-model", type=int, default=2048)
+    ap.add_argument("--vocab", type=int, default=32768)
+    ap.add_argument("--window", type=int, default=None,
+                    help="sliding-window width (tunes the banded kernel)")
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=["bfloat16", "float32"])
+    args = ap.parse_args(argv)
+
+    from chainermn_tpu.tuning import TuneCache, tune_lm_shapes
+
+    log = None if args.quiet else (lambda m: print(m, file=sys.stderr))
+
+    if not args.dry_run:
+        import jax
+
+        backend = jax.default_backend()
+        if backend not in ("tpu", "axon") and not args.allow_cpu:
+            print(json.dumps({
+                "error": f"refusing to time kernels on backend "
+                         f"{backend!r} — tuned configs are per device "
+                         "kind and a CPU measurement would steer "
+                         "nothing.  Use --dry-run to inspect the "
+                         "search space, or --allow-cpu to override.",
+            }))
+            return 2
+
+    cache = TuneCache(args.cache_path) if args.cache_path else None
+    out = tune_lm_shapes(
+        batch=args.batch, seq=args.seq, n_heads=args.heads,
+        d_model=args.d_model, vocab=args.vocab, window=args.window,
+        dtype=args.dtype, cache=cache, force=args.force,
+        dry_run=args.dry_run, n1=args.n1, repeats=args.repeats, log=log,
+    )
+    for kernel in ("flash", "fused_ce"):
+        print(json.dumps({kernel: out[kernel]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,57 @@
+"""Kernel autotuning — searched block configs for the Pallas hot paths.
+
+The hot kernels (``ops.flash_attention``, ``ops.fused_ce``) shipped with
+one magic geometry each: ``block_q``/``block_k`` ~ S/16 clamped to
+[128, 512] and ``chunk = 512``.  Blockwise TPU kernels are highly
+sensitive to tile shape, and the best choice shifts with sequence
+length, head dim, dtype and the causal/window band — so, following the
+reference framework's own design principle (expose the knob, but pick a
+fast default FOR the user: ``allreduce_grad_dtype``,
+``double_buffering``), this package measures the best config per shape
+once and remembers it:
+
+* :mod:`~chainermn_tpu.tuning.search_space` — per-kernel candidate
+  declarations (flash fwd/bwd ``block_q``×``block_k`` within VMEM
+  limits, fused-CE ``chunk``), each with the static default included so
+  a tuned pick can never lose to it;
+* :mod:`~chainermn_tpu.tuning.measure` — compile-and-time harness
+  (median-of-k slope timing via ``utils.profiling``; candidates that
+  fail to compile or OOM are skipped, not fatal);
+* :mod:`~chainermn_tpu.tuning.cache` — persistent JSON cache keyed by
+  ``(kernel, device_kind, dtype, shape bucket, causal/window flags)``,
+  path overridable via ``CHAINERMN_TPU_TUNE_CACHE`` (default under
+  ``/tmp``, never inside the repo);
+* :mod:`~chainermn_tpu.tuning.autotune` — the tuners and the runtime
+  lookups the ops consult when the caller does not pin blocks.
+
+Determinism guard: lookups and tuning are inert under pytest and on
+non-TPU backends — there the ops use their static defaults, bit-identical
+to the pre-tuning behavior.  Tuning itself only ever runs explicitly:
+``python -m chainermn_tpu.tools.autotune`` or ``bench.py --autotune``.
+"""
+
+from chainermn_tpu.tuning.cache import (  # noqa: F401
+    DEFAULT_CACHE_PATH,
+    ENV_AUTOTUNE,
+    ENV_CACHE_PATH,
+    TuneCache,
+    autotune_enabled,
+    bucket_pow2,
+    cache_path,
+    device_kind,
+    runtime_lookup_enabled,
+    shared_cache,
+)
+from chainermn_tpu.tuning.search_space import (  # noqa: F401
+    ce_cache_key,
+    ce_search_space,
+    flash_cache_key,
+    flash_search_space,
+)
+from chainermn_tpu.tuning.autotune import (  # noqa: F401
+    lookup_ce_chunk,
+    lookup_flash_blocks,
+    tune_flash,
+    tune_fused_ce,
+    tune_lm_shapes,
+)

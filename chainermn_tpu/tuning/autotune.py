@@ -1,0 +1,403 @@
+"""Tuners (measure → pick → persist) and the runtime lookups the ops
+consult.
+
+The tuners only ever run explicitly (CLI / ``bench.py --autotune``) —
+never from inside an op.  The lookups are trace-time reads of the
+persistent cache, validated against the *actual* call shape (pow2
+bucketing means a 3072-long call can hit a 4096-bucket entry whose
+blocks do not divide it — such an entry is ignored, not an error), and
+return None whenever tuning is disabled, off-TPU, or on a miss; the ops
+then use their static defaults.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Tuple
+
+import jax
+
+from chainermn_tpu.tuning.cache import (
+    TuneCache,
+    autotune_enabled,
+    device_kind,
+    dtype_name,
+    runtime_lookup_enabled,
+    shared_cache,
+)
+from chainermn_tpu.tuning.measure import best_config, measure_candidates
+from chainermn_tpu.tuning.search_space import (
+    ce_cache_key,
+    ce_search_space,
+    flash_cache_key,
+    flash_default_config,
+    flash_search_space,
+)
+
+
+def _blocks_valid(bq: int, bk: int, Sq: int, Sk: int, dtype) -> bool:
+    """Mirror of ``flash_attention``'s compiled-path gate: blocks divide
+    their sequences and meet the dtype's sublane alignment."""
+    sub = 16 if dtype_name(dtype) == "bfloat16" else 8
+    return (
+        bq >= 1 and bk >= 1
+        and Sq % bq == 0 and Sk % bk == 0
+        and bq % sub == 0 and bk % sub == 0
+    )
+
+
+# --------------------------------------------------------------------------
+# Runtime lookups — what flash_attention / fused_cross_entropy call when the
+# caller does not pin a geometry.
+# --------------------------------------------------------------------------
+
+
+def lookup_flash_blocks(
+    kind: str,
+    *,
+    Sq: int,
+    Sk: int,
+    D: int,
+    dtype,
+    causal: bool,
+    window: Optional[int] = None,
+    segmented: bool = False,
+) -> Optional[Tuple[int, int]]:
+    """Tuned ``(block_q, block_k)`` for the flash ``kind`` (``fwd`` /
+    ``bwd``) or None (miss, invalid entry, or lookups disabled)."""
+    if not runtime_lookup_enabled():
+        return None
+    try:
+        key = flash_cache_key(
+            kind, device_kind(), dtype, Sq, Sk, D, causal, window, segmented
+        )
+        entry = shared_cache().get(key)
+        if not entry:
+            return None
+        bq, bk = int(entry["block_q"]), int(entry["block_k"])
+    except Exception:
+        return None
+    if not _blocks_valid(bq, bk, Sq, Sk, dtype):
+        return None
+    return bq, bk
+
+
+def lookup_ce_chunk(*, N: int, V: int, D: int, dtype) -> Optional[int]:
+    """Tuned fused-CE row chunk or None (miss / disabled)."""
+    if not runtime_lookup_enabled():
+        return None
+    try:
+        entry = shared_cache().get(
+            ce_cache_key(device_kind(), dtype, N, V, D)
+        )
+        if not entry:
+            return None
+        chunk = int(entry["chunk"])
+    except Exception:
+        return None
+    return chunk if chunk >= 1 else None
+
+
+# --------------------------------------------------------------------------
+# Tuners.
+# --------------------------------------------------------------------------
+
+
+def _require_tuning_allowed(what: str):
+    if not autotune_enabled():
+        raise RuntimeError(
+            f"autotuning ({what}) is disabled in this context — under "
+            "pytest the tuner is inert by design (tier-1 determinism "
+            "guard), and CHAINERMN_TPU_AUTOTUNE=0 disables it explicitly"
+        )
+
+
+def _finish(key, results, default_cfg, cache, extra):
+    """Pick the winner, fold in provenance, persist."""
+    best = best_config(results)
+    if best is None:
+        return {"key": key, "chosen": None, "results": results,
+                "error": "every candidate failed"}
+    default_secs = next(
+        (r["seconds"] for r in results if r["config"] == default_cfg),
+        None,
+    )
+    entry = dict(best["config"])
+    entry.update(
+        seconds=best["seconds"],
+        default_config=default_cfg,
+        default_seconds=default_secs,
+        speedup_vs_default=(
+            round(default_secs / best["seconds"], 4)
+            if default_secs else None
+        ),
+        candidates_timed=sum(1 for r in results if r["seconds"] is not None),
+        candidates_skipped=sum(1 for r in results if r["seconds"] is None),
+        device_kind=device_kind(),
+        jax_version=jax.__version__,
+        tuned_at=time.strftime("%Y-%m-%dT%H:%M:%S"),
+        source="chainermn_tpu.tuning.autotune",
+        **extra,
+    )
+    cache.put(key, entry)
+    cache.save()
+    return {"key": key, "chosen": dict(best["config"]),
+            "seconds": best["seconds"], "default_seconds": default_secs,
+            "speedup_vs_default": entry["speedup_vs_default"],
+            "results": results, "cache_path": cache.path}
+
+
+def tune_flash(
+    *,
+    Sq: int,
+    Sk: int,
+    D: int,
+    dtype="bfloat16",
+    causal: bool = True,
+    window: Optional[int] = None,
+    batch_heads: int = 8,
+    cache: Optional[TuneCache] = None,
+    n1: int = 3,
+    repeats: int = 3,
+    force: bool = False,
+    dry_run: bool = False,
+    log: Optional[Callable[[str], None]] = None,
+) -> dict:
+    """Tune the flash attention forward AND backward block geometry for
+    one shape family; returns ``{"fwd": record, "bwd": record}``.
+
+    The backward sweep pins the forward blocks to the forward winner and
+    varies only the backward geometry (``jax.grad`` re-runs the forward,
+    so holding it constant isolates the backward's contribution to the
+    argmin).  ``dry_run`` enumerates candidates without compiling or
+    timing anything.
+    """
+    import numpy as np
+
+    fwd_space = flash_search_space(Sq, Sk, D, dtype, which="fwd")
+    bwd_space = flash_search_space(Sq, Sk, D, dtype, which="bwd")
+    default_cfg = flash_default_config(Sq, Sk)
+    dev = device_kind()
+    fwd_key = flash_cache_key("fwd", dev, dtype, Sq, Sk, D, causal, window)
+    bwd_key = flash_cache_key("bwd", dev, dtype, Sq, Sk, D, causal, window)
+    if dry_run:
+        return {
+            "kernel": "flash", "dry_run": True,
+            "fwd": {"key": fwd_key, "candidates": fwd_space,
+                    "default": default_cfg},
+            "bwd": {"key": bwd_key, "candidates": bwd_space,
+                    "default": default_cfg},
+        }
+    _require_tuning_allowed("flash attention")
+    cache = cache or shared_cache()
+
+    from chainermn_tpu.ops.flash_attention import _flash_bh, _flash_bh_fwd
+    from chainermn_tpu.utils.profiling import sync
+
+    scale = 1.0 / (D ** 0.5)
+    rng = np.random.RandomState(0)
+    q = jax.numpy.asarray(
+        rng.randn(batch_heads, Sq, D), dtype_name(dtype)
+    )
+    k = jax.numpy.asarray(
+        rng.randn(batch_heads, Sk, D), dtype_name(dtype)
+    )
+    v = jax.numpy.asarray(
+        rng.randn(batch_heads, Sk, D), dtype_name(dtype)
+    )
+
+    out = {"kernel": "flash"}
+
+    cached = cache.get(fwd_key) if not force else None
+    if cached and _blocks_valid(
+        int(cached.get("block_q", 0)), int(cached.get("block_k", 0)),
+        Sq, Sk, dtype,
+    ):
+        out["fwd"] = {
+            "key": fwd_key, "cached": True,
+            "chosen": {"block_q": int(cached["block_q"]),
+                       "block_k": int(cached["block_k"])},
+        }
+    else:
+        if log:
+            log(f"flash fwd {fwd_key}: {len(fwd_space)} candidates")
+
+        def build_fwd(cfg):
+            f = jax.jit(
+                lambda q, k, v: _flash_bh_fwd(
+                    q, k, v, scale=scale, causal=causal,
+                    block_q=cfg["block_q"], block_k=cfg["block_k"],
+                    interpret=False, window=window,
+                )[0]
+            )
+
+            def run(n):
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    o = f(q, k, v)
+                sync(o)
+                return time.perf_counter() - t0
+
+            return run
+
+        results = measure_candidates(
+            build_fwd, fwd_space, n1=n1, repeats=repeats, log=log
+        )
+        out["fwd"] = _finish(
+            fwd_key, results, default_cfg, cache,
+            {"kernel": "flash_fwd", "dtype": dtype_name(dtype),
+             "Sq": Sq, "Sk": Sk, "D": D, "causal": causal,
+             "window": window, "batch_heads": batch_heads},
+        )
+
+    fq = out["fwd"]["chosen"] or default_cfg
+    cached = cache.get(bwd_key) if not force else None
+    if cached and _blocks_valid(
+        int(cached.get("block_q", 0)), int(cached.get("block_k", 0)),
+        Sq, Sk, dtype,
+    ):
+        out["bwd"] = {
+            "key": bwd_key, "cached": True,
+            "chosen": {"block_q": int(cached["block_q"]),
+                       "block_k": int(cached["block_k"])},
+        }
+        return out
+    if log:
+        log(f"flash bwd {bwd_key}: {len(bwd_space)} candidates")
+
+    def build_bwd(cfg):
+        def loss(q, k, v):
+            return _flash_bh(
+                q, k, v, scale, causal, fq["block_q"], fq["block_k"],
+                False, window, cfg["block_q"], cfg["block_k"],
+            ).sum()
+
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+        def run(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                dq, dk, dv = g(q, k, v)
+            sync(dq)
+            return time.perf_counter() - t0
+
+        return run
+
+    results = measure_candidates(
+        build_bwd, bwd_space, n1=n1, repeats=repeats, log=log
+    )
+    out["bwd"] = _finish(
+        bwd_key, results, default_cfg, cache,
+        {"kernel": "flash_bwd", "dtype": dtype_name(dtype),
+         "Sq": Sq, "Sk": Sk, "D": D, "causal": causal,
+         "window": window, "batch_heads": batch_heads,
+         "fwd_blocks": fq},
+    )
+    return out
+
+
+def tune_fused_ce(
+    *,
+    N: int,
+    V: int,
+    D: int,
+    dtype="bfloat16",
+    cache: Optional[TuneCache] = None,
+    n1: int = 3,
+    repeats: int = 3,
+    force: bool = False,
+    dry_run: bool = False,
+    log: Optional[Callable[[str], None]] = None,
+) -> dict:
+    """Tune the fused cross-entropy row chunk for an ``(N, V, D)`` loss
+    head; times the full fwd+bwd (``value_and_grad``), which is what the
+    training step pays."""
+    import numpy as np
+
+    from chainermn_tpu.ops.fused_ce import DEFAULT_CHUNK, _pick_chunk
+
+    space = ce_search_space(N, V, D, dtype)
+    default_cfg = {"chunk": _pick_chunk(N, DEFAULT_CHUNK)}
+    key = ce_cache_key(device_kind(), dtype, N, V, D)
+    if dry_run:
+        return {"kernel": "fused_ce", "dry_run": True, "key": key,
+                "candidates": space, "default": default_cfg}
+    _require_tuning_allowed("fused cross-entropy")
+    cache = cache or shared_cache()
+    cached = cache.get(key) if not force else None
+    if cached and int(cached.get("chunk", 0)) >= 1:
+        return {"kernel": "fused_ce", "key": key, "cached": True,
+                "chosen": {"chunk": int(cached["chunk"])}}
+
+    from chainermn_tpu.ops.fused_ce import fused_cross_entropy
+    from chainermn_tpu.utils.profiling import sync
+
+    rng = np.random.RandomState(0)
+    h = jax.numpy.asarray(rng.randn(N, D), dtype_name(dtype))
+    emb = jax.numpy.asarray(rng.randn(V, D), dtype_name(dtype))
+    labels = jax.numpy.asarray(
+        rng.randint(0, V, size=(N,)), "int32"
+    )
+    if log:
+        log(f"fused_ce {key}: {len(space)} candidates")
+
+    def build(cfg):
+        g = jax.jit(jax.value_and_grad(
+            lambda h, emb: fused_cross_entropy(
+                h, emb, labels, chunk=cfg["chunk"]
+            ),
+            argnums=(0, 1),
+        ))
+
+        def run(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                _loss, (dh, _demb) = g(h, emb)
+            sync(dh)
+            return time.perf_counter() - t0
+
+        return run
+
+    results = measure_candidates(build, space, n1=n1, repeats=repeats,
+                                 log=log)
+    rec = _finish(
+        key, results, default_cfg, cache,
+        {"kernel": "fused_ce", "dtype": dtype_name(dtype),
+         "N": N, "V": V, "D": D},
+    )
+    rec["kernel"] = "fused_ce"
+    return rec
+
+
+def tune_lm_shapes(
+    *,
+    batch: int,
+    seq: int,
+    n_heads: int,
+    d_model: int,
+    vocab: int,
+    window: Optional[int] = None,
+    dtype="bfloat16",
+    cache: Optional[TuneCache] = None,
+    force: bool = False,
+    dry_run: bool = False,
+    n1: int = 3,
+    repeats: int = 3,
+    log: Optional[Callable[[str], None]] = None,
+) -> dict:
+    """Tune every searched kernel the LM bench step hits — the flash
+    fwd/bwd geometry at the step's (batch*heads, S, head_dim) and the CE
+    chunk at its (batch*S, vocab, d_model).  This is what
+    ``bench.py --autotune`` and the CLI's default mode call."""
+    if d_model % n_heads:
+        raise ValueError(f"d_model {d_model} not divisible by heads {n_heads}")
+    flash = tune_flash(
+        Sq=seq, Sk=seq, D=d_model // n_heads, dtype=dtype, causal=True,
+        window=window, batch_heads=batch * n_heads, cache=cache,
+        force=force, dry_run=dry_run, n1=n1, repeats=repeats, log=log,
+    )
+    ce = tune_fused_ce(
+        N=batch * seq, V=vocab, D=d_model, dtype=dtype, cache=cache,
+        force=force, dry_run=dry_run, n1=n1, repeats=repeats, log=log,
+    )
+    return {"flash": flash, "fused_ce": ce}

@@ -1,0 +1,179 @@
+"""Per-kernel search-space declarations.
+
+Each kernel declares (a) its candidate configs, filtered to what can
+actually compile — divisibility of the sequence/row count, sublane
+alignment, and a VMEM budget per grid program — and (b) its cache-key
+schema.  The kernel's own static default is ALWAYS a member of the
+space, so the measured argmin can never be slower than shipping the
+magic number (the tuner picks the default when nothing beats it).
+
+VMEM model (v4/v5 class chips have ~16 MiB/core): a Pallas grid program
+holds its input blocks double-buffered (the pipeline prefetches tile
+``i+1`` while computing ``i``), its output blocks double-buffered, and
+its scratch once.  The estimate errs conservative — Mosaic pads the lane
+(last) dim to a multiple of 128 — and candidates over budget are pruned
+before compilation rather than left to die as OOM (they are *also*
+skipped-on-error in the measure harness, for the shapes the model
+misjudges).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from chainermn_tpu.tuning.cache import bucket_pow2, make_key
+
+VMEM_BYTES = 16 * 1024 * 1024
+#: fraction of VMEM the estimate may claim — headroom for Mosaic's own
+#: temporaries and the iota/mask intermediates inside the kernel body.
+VMEM_BUDGET_FRACTION = 0.75
+
+#: candidate tile edges: every multiple-of-sublane power of two between
+#: the smallest tile worth scheduling and the largest that a 16 MiB VMEM
+#: can double-buffer at common head dims.
+BLOCK_CANDIDATES = (64, 128, 256, 512, 1024)
+
+#: fused-CE row-chunk candidates; the static default 512 sits mid-range.
+CE_CHUNK_CANDIDATES = (128, 256, 512, 1024, 2048, 4096)
+
+#: cap on the transient (chunk, V) fp32 logit tile the CE scan holds.
+CE_TILE_BYTES_MAX = 512 * 1024 * 1024
+
+
+def _pad_lane(d: int) -> int:
+    """Mosaic pads the lane (last) dim to a multiple of 128."""
+    return max(128, ((int(d) + 127) // 128) * 128)
+
+
+def _sublane(dtype) -> int:
+    from chainermn_tpu.tuning.cache import dtype_name
+
+    return 16 if dtype_name(dtype) == "bfloat16" else 8
+
+
+def flash_vmem_bytes(block_q: int, block_k: int, D: int, itemsize: int,
+                     which: str = "fwd", segmented: bool = False) -> int:
+    """Estimated VMEM bytes for one grid program of the flash kernels.
+
+    ``which``: ``"fwd"`` models the forward kernel; ``"bwd"`` the max of
+    the dq and dk/dv kernels (they are separate ``pallas_call``s, so the
+    binding constraint is whichever is larger).
+    """
+    Dp = _pad_lane(D)
+    qd = block_q * Dp
+    kd = block_k * Dp
+    seg = 2 * (block_q + block_k) * 4 if segmented else 0
+    if which == "fwd":
+        inputs = 2 * (qd + 2 * kd) * itemsize + seg
+        outputs = 2 * (qd * itemsize + block_q * 4)
+        scratch = qd * 4 + 2 * block_q * 4
+        return inputs + outputs + scratch
+    # backward: q, k, v, do + lse, delta rows in both kernels
+    rows = 2 * 2 * block_q * 4
+    dq_in = 2 * (2 * qd + 2 * kd) * itemsize + rows + seg
+    dq_total = dq_in + 2 * qd * itemsize + qd * 4
+    dkv_in = 2 * (2 * qd + 2 * kd) * itemsize + rows + seg
+    dkv_total = dkv_in + 2 * 2 * kd * itemsize + 2 * kd * 4
+    return max(dq_total, dkv_total)
+
+
+def flash_search_space(
+    Sq: int,
+    Sk: int,
+    D: int,
+    dtype,
+    which: str = "fwd",
+    segmented: bool = False,
+    vmem_budget: Optional[int] = None,
+) -> List[dict]:
+    """Valid ``{"block_q", "block_k"}`` candidates for the flash kernels:
+    blocks divide their sequence, meet the dtype's sublane alignment, and
+    fit the VMEM budget.  The static auto default is inserted if the
+    filters somehow excluded it (it compiles today, so it stays
+    reachable)."""
+    import numpy as np
+
+    from chainermn_tpu.ops.flash_attention import auto_block_size
+
+    if vmem_budget is None:
+        vmem_budget = int(VMEM_BYTES * VMEM_BUDGET_FRACTION)
+    itemsize = np.dtype(dtype).itemsize
+    sub = _sublane(dtype)
+    out = []
+    for bq in BLOCK_CANDIDATES:
+        if bq > Sq or Sq % bq or bq % sub:
+            continue
+        for bk in BLOCK_CANDIDATES:
+            if bk > Sk or Sk % bk or bk % sub:
+                continue
+            if flash_vmem_bytes(bq, bk, D, itemsize, which,
+                                segmented) > vmem_budget:
+                continue
+            out.append({"block_q": bq, "block_k": bk})
+    default = {"block_q": auto_block_size(Sq), "block_k": auto_block_size(Sk)}
+    if default not in out:
+        out.append(default)
+    return out
+
+
+def flash_default_config(Sq: int, Sk: int) -> dict:
+    """The static default geometry (what a cache miss resolves to)."""
+    from chainermn_tpu.ops.flash_attention import auto_block_size
+
+    return {"block_q": auto_block_size(Sq), "block_k": auto_block_size(Sk)}
+
+
+def flash_cache_key(kind: str, dev_kind: str, dtype, Sq: int, Sk: int,
+                    D: int, causal: bool, window: Optional[int],
+                    segmented: bool = False) -> str:
+    """Cache key for the flash kernels.  ``kind``: ``fwd`` or ``bwd`` —
+    forward and backward tile economics differ (the backward streams two
+    extra operands and runs two kernels), so they tune independently.
+    Sequence lengths are pow2-bucketed; head dim, causality, window width
+    and segmenting are exact — each changes the kernel's inner loop."""
+    if kind not in ("fwd", "bwd"):
+        raise ValueError(f"kind must be 'fwd' or 'bwd', got {kind!r}")
+    return make_key(
+        f"flash_{kind}",
+        dev_kind,
+        dtype,
+        (("q", bucket_pow2(Sq)), ("k", bucket_pow2(Sk)), ("d", D)),
+        {
+            "causal": bool(causal),
+            "window": 0 if window is None else int(window),
+            "seg": bool(segmented),
+        },
+    )
+
+
+def ce_search_space(N: int, V: int, D: int, dtype=None) -> List[dict]:
+    """Valid ``{"chunk"}`` candidates for the fused cross-entropy: chunk
+    divides the row count (the scan needs equal tiles; ``_pick_chunk``
+    would silently shrink a non-divisor, making it a duplicate config)
+    and the transient ``(chunk, V)`` fp32 tile stays bounded.  The static
+    default chunk is always included."""
+    from chainermn_tpu.ops.fused_ce import DEFAULT_CHUNK, _pick_chunk
+
+    out = []
+    for c in CE_CHUNK_CANDIDATES:
+        if c > N or N % c:
+            continue
+        if c * V * 4 > CE_TILE_BYTES_MAX:
+            continue
+        out.append({"chunk": c})
+    default = {"chunk": _pick_chunk(N, DEFAULT_CHUNK)}
+    if default not in out:
+        out.append(default)
+    return out
+
+
+def ce_cache_key(dev_kind: str, dtype, N: int, V: int, D: int) -> str:
+    """Cache key for the fused CE: token count pow2-bucketed (the scan
+    length), vocab and model dim exact (they set the tile shape)."""
+    return make_key(
+        "fused_ce",
+        dev_kind,
+        dtype,
+        (("n", bucket_pow2(N)), ("v", V), ("d", D)),
+        {},
+    )

@@ -53,6 +53,17 @@ def slope_time(run, n1: int, n2: Optional[int] = None) -> float:
     return (t2 - t1) / (n2 - n1)
 
 
+def median_slope(run, n1: int = 5, repeats: int = 3):
+    """Median of ``repeats`` independent :func:`slope_time` measurements,
+    with the sorted samples — on the tunneled chip one slope sample is
+    not a number (run-to-run variance has masqueraded as real deltas
+    before).  The shared timing backbone of ``bench.py`` and the kernel
+    autotuner (``chainermn_tpu.tuning``).  Returns
+    ``(median_seconds_per_iter, sorted_samples)``."""
+    samples = sorted(slope_time(run, n1) for _ in range(repeats))
+    return samples[len(samples) // 2], samples
+
+
 def sync(tree):
     """Hard execution barrier: force every array in ``tree`` to finish
     executing by reading one element back to the host.

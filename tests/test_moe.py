@@ -280,3 +280,51 @@ def test_moe_rejects_mismatched_gate_width(ep_mesh):
     )
     with pytest.raises(ValueError, match="experts/device"):
         jax.jit(f)(x, gate_w, experts)
+
+
+def _shard_map_norep(body, **kw):
+    """shard_map with replication checking off, across jax versions
+    (the kwarg was renamed check_rep -> check_vma)."""
+    for flag in ("check_vma", "check_rep"):
+        try:
+            return shard_map(body, **{**kw, flag: False})
+        except TypeError:
+            continue
+    return shard_map(body, **kw)
+
+
+def test_return_aux_scalar_shim(ep_mesh):
+    """One-release back-compat: ``return_aux='scalar'`` restores the old
+    ``(y, load_balance_loss)`` contract (with a DeprecationWarning);
+    ``return_aux=True`` now returns ``(y, aux_dict)``."""
+    experts = make_experts()
+    gate_w = jax.random.normal(jax.random.PRNGKey(11), (D, E)) * 0.5
+    x = jax.random.normal(jax.random.PRNGKey(12), (E * T_PER_DEV, D))
+
+    def body(mode):
+        def inner(x, gate_w, experts):
+            mine = jax.tree.map(lambda p: jnp.squeeze(p, 0), experts)
+            y, aux = moe_layer(
+                x, gate_w, expert_fn, mine, "intra", return_aux=mode
+            )
+            scalar = aux["load_balance_loss"] if mode is True else aux
+            return y, jax.lax.pmean(scalar, "intra")
+
+        return inner
+
+    specs = dict(
+        mesh=ep_mesh,
+        in_specs=(P("intra"), P(), P("intra")),
+        out_specs=(P("intra"), P()),
+    )
+    y_new, lbl_new = jax.jit(_shard_map_norep(body(True), **specs))(
+        x, gate_w, experts
+    )
+    with pytest.warns(DeprecationWarning, match="scalar"):
+        y_old, lbl_old = jax.jit(_shard_map_norep(body("scalar"), **specs))(
+            x, gate_w, experts
+        )
+    # The shim's scalar IS the dict's load_balance_loss; y unchanged.
+    np.testing.assert_allclose(np.asarray(y_old), np.asarray(y_new),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(float(lbl_old), float(lbl_new), rtol=1e-6)

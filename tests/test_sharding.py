@@ -427,3 +427,35 @@ def test_sp_vocab_tp_end_to_end_grads_match(tp_mesh):
                                rtol=5e-2, atol=2e-3)
     np.testing.assert_allclose(np.asarray(gw), np.asarray(ref_gw),
                                rtol=5e-2, atol=2e-3)
+
+
+def test_gspmd_moments_follow_path_not_shape(dp_tp_mesh):
+    """Two SAME-shape params with DIFFERENT shardings: each moment must
+    ride its own parameter's sharding via the tree-path association (a
+    shape-keyed first-match-wins lookup mis-shards one of them), and
+    scalar state (adam's count) stays replicated."""
+    from jax.sharding import NamedSharding
+
+    params = {
+        "a": {"kernel": jnp.ones((16, 16))},
+        "b": {"kernel": jnp.ones((16, 16))},
+    }
+    spec = {
+        "a": {"kernel": P("model", None)},
+        "b": {"kernel": P(None, "model")},
+    }
+    optimizer = optax.adam(1e-2)
+
+    def loss_fn(p, batch):
+        return jnp.sum((batch @ p["a"]["kernel"] @ p["b"]["kernel"]) ** 2)
+
+    _, shard_fn = make_gspmd_train_step(
+        loss_fn, optimizer, dp_tp_mesh, spec, data_axis="data"
+    )
+    sp, so = shard_fn(params, optimizer.init(params))
+    for moment in (so[0].mu, so[0].nu):
+        assert moment["a"]["kernel"].sharding == sp["a"]["kernel"].sharding
+        assert moment["b"]["kernel"].sharding == sp["b"]["kernel"].sharding
+        assert (moment["a"]["kernel"].sharding
+                != moment["b"]["kernel"].sharding)
+    assert so[0].count.sharding == NamedSharding(dp_tp_mesh, P())
