@@ -33,6 +33,7 @@ own stage rates are measured in isolation and recorded alongside.
 """
 
 import argparse
+import contextlib
 import json
 import os
 import time
@@ -448,8 +449,20 @@ def main(argv=None):
                          "tune cache), then bench with the chosen configs "
                          "pinned; the chosen (block_q, block_k, chunk) "
                          "land under the LM result's \"autotune\" key")
+    ap.add_argument("--step-log", default=None, metavar="PATH",
+                    help="write a JSONL event log of the bench run "
+                         "(compile events, instrumented-step spans, the "
+                         "final result row); summarize with `python -m "
+                         "chainermn_tpu.tools.obs summarize PATH`")
     args = ap.parse_args(argv)
     comm = chainermn_tpu.create_communicator("xla_ici")
+
+    telemetry = contextlib.ExitStack()
+    recorder = None
+    if args.step_log:
+        from chainermn_tpu.observability import StepRecorder
+
+        recorder = telemetry.enter_context(StepRecorder(args.step_log))
 
     if args.only == "lm":
         out = bench_lm(comm, args)
@@ -459,6 +472,10 @@ def main(argv=None):
         out = bench_resnet(comm, args)
         out["lm"] = bench_lm(comm, args)
         out["allreduce_static_bytes_per_leg"] = _static_allreduce_table()
+    if recorder is not None:
+        recorder.step()  # flush buffered compile events and step spans
+        recorder.record("bench_result", result=out)
+    telemetry.close()
     print(json.dumps(out))
 
 
@@ -469,7 +486,12 @@ def _static_allreduce_table():
     communicator algorithms' wire structure — including the asserted
     two_dimensional inter-leg = flat/intra_size claim — recorded next to
     the measured numbers for the judge (ICI bandwidth itself remains
-    unmeasurable on one chip)."""
+    unmeasurable on one chip).
+
+    The census itself now lives in
+    :mod:`chainermn_tpu.observability.hlo_audit` (``audit_allreduce``);
+    the subprocess's ``allreduce_bench.py --static-only`` is a thin
+    consumer, so these numbers and the library API cannot drift apart."""
     import subprocess
     import sys
 

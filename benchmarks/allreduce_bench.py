@@ -40,6 +40,12 @@ import jax.numpy as jnp
 import numpy as np
 
 
+from chainermn_tpu.observability.hlo_audit import (  # noqa: F401
+    assert_two_dimensional_inter_savings,  # re-export: external callers
+    audit_allreduce,
+)
+
+
 def collective_profile(comm, nbytes: int, dtype) -> dict:
     """Per-communicator collective-op counts from the traced
     ``allreduce_grad`` lowering (jaxpr-level, environment-independent).
@@ -47,65 +53,11 @@ def collective_profile(comm, nbytes: int, dtype) -> dict:
     Recorded alongside every bandwidth number so a future multi-chip run
     is one command AND the algorithm each backend actually lowered to is
     pinned in the same JSON line (e.g. two_dimensional must show
-    psum_scatter + psum + all_gather; xla_ici one fused psum)."""
-    import jax
+    psum_scatter + psum + all_gather; xla_ici one fused psum).
 
-    n = comm.device_size
-    elems = max(1, nbytes // np.dtype(dtype).itemsize)
-    spec = comm._world_spec
-
-    def body(tree):
-        sq = jax.tree.map(lambda x: jnp.squeeze(x, 0), tree)
-        out = comm.allreduce_grad(sq)
-        return jax.tree.map(lambda x: x[None], out)
-
-    jaxpr = jax.make_jaxpr(comm.shard_map(
-        body, in_specs=({"g": spec},), out_specs={"g": spec}
-    ))({"g": jnp.ones((n, elems), dtype)})
-
-    # Exact primitive-name counts, recursing into inner jaxprs (the
-    # collectives live inside the shard_map eqn) — not text substrings,
-    # which would also match any psum-/all_gather-variant names.
-    counts: dict = {}
-
-    def walk(jp):
-        for eqn in jp.eqns:
-            counts[eqn.primitive.name] = (
-                counts.get(eqn.primitive.name, 0) + 1
-            )
-            for val in eqn.params.values():
-                # Inner jaxprs appear as raw Jaxpr (has .eqns) or
-                # ClosedJaxpr (has .jaxpr) param values.
-                if hasattr(val, "eqns"):
-                    walk(val)
-                elif hasattr(val, "jaxpr"):
-                    walk(val.jaxpr)
-
-    walk(jaxpr.jaxpr)
-    # lax.psum_scatter traces to the `reduce_scatter` primitive.
-    return {
-        key: counts.get(key, 0)
-        for key in ("psum", "reduce_scatter", "all_gather", "ppermute")
-    }
-
-
-_COLLECTIVES = ("psum", "reduce_scatter", "all_gather", "ppermute",
-                "all_to_all")
-
-
-def _eqn_axes(eqn):
-    """Mesh-axis names a collective eqn runs over, as a tuple."""
-    for key in ("axes", "axis_name"):
-        if key in eqn.params:
-            ax = eqn.params[key]
-            if isinstance(ax, (tuple, list)):
-                out = []
-                for a in ax:
-                    out.extend(a) if isinstance(a, (tuple, list)) \
-                        else out.append(a)
-                return tuple(out)
-            return (ax,)
-    return ()
+    Thin view over :mod:`chainermn_tpu.observability.hlo_audit` — the
+    library owns the census; this keeps the bench's record shape."""
+    return audit_allreduce(comm, nbytes, dtype).census()
 
 
 def bytes_per_leg(comm, nbytes: int, dtype) -> dict:
@@ -120,65 +72,10 @@ def bytes_per_leg(comm, nbytes: int, dtype) -> dict:
     ``intra_size``, because the inter psum runs on the
     ``reduce_scatter``'d 1/intra shard (SURVEY §2.1 two-dimensional row;
     the reference's rationale for the 2D algorithm on >1 GbE clusters).
-    """
-    import jax
 
-    n = comm.device_size
-    elems = max(1, nbytes // np.dtype(dtype).itemsize)
-    spec = comm._world_spec
-
-    def body(tree):
-        sq = jax.tree.map(lambda x: jnp.squeeze(x, 0), tree)
-        out = comm.allreduce_grad(sq)
-        return jax.tree.map(lambda x: x[None], out)
-
-    jaxpr = jax.make_jaxpr(comm.shard_map(
-        body, in_specs=({"g": spec},), out_specs={"g": spec}
-    ))({"g": jnp.ones((n, elems), dtype)})
-
-    per_axis: dict = {}
-
-    def walk(jp):
-        for eqn in jp.eqns:
-            if eqn.primitive.name in _COLLECTIVES:
-                op_bytes = sum(
-                    int(np.prod(v.aval.shape))
-                    * np.dtype(v.aval.dtype).itemsize
-                    for v in eqn.invars
-                    if hasattr(v.aval, "shape")
-                )
-                for ax in _eqn_axes(eqn):
-                    per_axis[str(ax)] = per_axis.get(str(ax), 0) + op_bytes
-            for val in eqn.params.values():
-                if hasattr(val, "eqns"):
-                    walk(val)
-                elif hasattr(val, "jaxpr"):
-                    walk(val.jaxpr)
-
-    walk(jaxpr.jaxpr)
-    return per_axis
-
-
-def assert_two_dimensional_inter_savings(profiles: dict,
-                                         intra_size: int) -> None:
-    """``profiles``: {communicator_name: bytes_per_leg dict}.  Asserts the
-    2D claim when both sides are present: two_dimensional's inter-axis
-    operand bytes == flat's / intra_size."""
-    flat = next(
-        (profiles[k] for k in ("flat", "xla_ici", "pure_nccl")
-         if k in profiles), None,
-    )
-    td = profiles.get("two_dimensional")
-    if flat is None or td is None:
-        return
-    flat_inter = flat.get("inter", 0)
-    td_inter = td.get("inter", 0)
-    assert flat_inter > 0 and td_inter > 0, (profiles,)
-    assert td_inter * intra_size == flat_inter, (
-        f"two_dimensional inter-axis bytes {td_inter} x intra "
-        f"{intra_size} != flat's {flat_inter} — the 2D bandwidth claim "
-        "does not hold in the traced lowering"
-    )
+    Thin view over :func:`hlo_audit.audit_allreduce` (one source of
+    truth for the bytes-per-leg metric)."""
+    return audit_allreduce(comm, nbytes, dtype).bytes_per_axis
 
 
 def bench_one(comm, nbytes: int, dtype, iters: int, warmup: int) -> dict:

@@ -39,10 +39,10 @@ def __getattr__(name):
         from chainermn_tpu import links
 
         return getattr(links, name)
-    if name in ("functions",):
+    if name in ("functions", "observability"):
         import importlib
 
-        return importlib.import_module("chainermn_tpu.functions")
+        return importlib.import_module(f"chainermn_tpu.{name}")
     if name in (
         "create_multi_node_iterator",
         "create_synchronized_iterator",

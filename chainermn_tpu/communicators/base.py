@@ -56,9 +56,31 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import kvtransport, mesh_utils
 
 try:  # jax >= 0.4.35
-    from jax import shard_map as _shard_map
+    from jax import shard_map as _shard_map_impl
 except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map as _shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+# The replication-check kwarg was renamed check_rep -> check_vma across
+# jax releases; probe once which spelling this jax takes.
+import inspect as _inspect
+
+_SHARD_MAP_REP_KW = (
+    "check_vma"
+    if "check_vma" in _inspect.signature(_shard_map_impl).parameters
+    else "check_rep"
+)
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``shard_map`` across jax versions: forwards ``check_vma`` under
+    whichever replication-check spelling this jax accepts."""
+    return _shard_map_impl(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_SHARD_MAP_REP_KW: check_vma},
+    )
+
+
+_shard_map = shard_map_compat
 
 
 _PPERMUTE_FALLBACK_WARNED = False

@@ -30,6 +30,24 @@ from jax.sharding import PartitionSpec as P
 from chainermn_tpu.communicators.base import CommunicatorBase
 
 
+def _publish_eval_metrics(metrics: Dict[str, float]) -> None:
+    """Report the host-aggregated metric dict into whatever telemetry is
+    installed: ``eval/<name>`` scalars on the current Reporter and one
+    ``{"event": "eval", ...}`` row on the current StepRecorder.  Free
+    when telemetry is off."""
+    from chainermn_tpu.observability import spans as _spans
+
+    if not _spans.telemetry_active():
+        return
+    from chainermn_tpu.observability import reporter as _rep
+    from chainermn_tpu.observability import step_log as _step_log
+
+    _rep.report({f"eval/{k}": v for k, v in metrics.items()})
+    rec = _step_log.current_recorder()
+    if rec is not None:
+        rec.record("eval", metrics=metrics)
+
+
 def create_multi_node_evaluator(actual_evaluator, communicator: CommunicatorBase):
     """Wrap ``actual_evaluator.evaluate`` with cross-host metric averaging
     (reference-parity API)."""
@@ -43,7 +61,9 @@ def create_multi_node_evaluator(actual_evaluator, communicator: CommunicatorBase
             {k: float(v) for k, v in local.items()},
             op=lambda a, b: {k: a[k] + b[k] for k in a},
         )
-        return {k: v / n for k, v in summed.items()}
+        result = {k: v / n for k, v in summed.items()}
+        _publish_eval_metrics(result)
+        return result
 
     actual_evaluator.evaluate = evaluate
     return actual_evaluator
@@ -78,23 +98,28 @@ class Evaluator:
         )
 
     def evaluate(self, params, batches) -> Dict[str, float]:
+        from chainermn_tpu.observability.spans import span
+
         totals: Dict[str, float] = {}
         count = 0
-        for batch in batches:
+        with span("evaluate"):
+            for batch in batches:
+                if self.comm.size > 1:
+                    # Multi-process: each rank yields its LOCAL slice; the
+                    # jitted step wants the device-global batch.  (Every
+                    # rank must yield the same number of batches —
+                    # guaranteed by scatter_dataset's force_equal_length
+                    # default.)
+                    batch = self.comm.global_batch(batch)
+                out = self._step(params, batch)
+                for k, v in out.items():
+                    totals[k] = totals.get(k, 0.0) + float(v)
+                count += 1
+            local = {k: v / max(count, 1) for k, v in totals.items()}
             if self.comm.size > 1:
-                # Multi-process: each rank yields its LOCAL slice; the
-                # jitted step wants the device-global batch.  (Every rank
-                # must yield the same number of batches — guaranteed by
-                # scatter_dataset's force_equal_length default.)
-                batch = self.comm.global_batch(batch)
-            out = self._step(params, batch)
-            for k, v in out.items():
-                totals[k] = totals.get(k, 0.0) + float(v)
-            count += 1
-        local = {k: v / max(count, 1) for k, v in totals.items()}
-        if self.comm.size > 1:
-            summed = self.comm.allreduce_obj(
-                local, op=lambda a, b: {k: a[k] + b[k] for k in a}
-            )
-            local = {k: v / self.comm.size for k, v in summed.items()}
+                summed = self.comm.allreduce_obj(
+                    local, op=lambda a, b: {k: a[k] + b[k] for k in a}
+                )
+                local = {k: v / self.comm.size for k, v in summed.items()}
+        _publish_eval_metrics(local)
         return local

@@ -1,0 +1,49 @@
+"""Cross-host telemetry: metrics registry, step-event log, HLO collective
+audit, and trace spans — the first layer that sees every rank every step.
+
+The reference stack's visibility came from Chainer's ``Reporter`` +
+``LogReport`` extensions plus external nvprof (SURVEY §5.1).  Here the
+telemetry is library-native and SPMD-aware:
+
+* :class:`Reporter` — scalars/counters/histograms per process,
+  :meth:`Reporter.aggregate` merging across hosts through the
+  communicator's object plane (mean/sum/max on rank 0, off-TPU safe).
+* :class:`StepRecorder` — structured JSONL step-event log with atomic
+  append, rotation, crash-safe partial-line recovery, compile events
+  (``jax.monitoring``) and device-memory stats.
+* :mod:`hlo_audit` — per-collective counts and per-mesh-axis operand
+  bytes of any traced step fn (the generalized bench census).
+* :func:`span` — named regions on the profiler timeline AND in the
+  JSONL log with host-side durations; :func:`named_scope` for traced
+  code.
+
+Summarize/export a log with ``python -m chainermn_tpu.tools.obs``
+(incl. Prometheus textfile output).  See ``docs/observability.md``.
+"""
+
+from chainermn_tpu.observability.reporter import (  # noqa: F401
+    Reporter,
+    get_reporter,
+    merge_summaries,
+    report,
+    scope,
+)
+from chainermn_tpu.observability.step_log import (  # noqa: F401
+    StepRecorder,
+    current_recorder,
+    device_memory_stats,
+    read_records,
+    recover,
+    recording,
+)
+from chainermn_tpu.observability.hlo_audit import (  # noqa: F401
+    CollectiveAudit,
+    audit_allreduce,
+    audit_fn,
+    audit_jaxpr,
+)
+from chainermn_tpu.observability.spans import (  # noqa: F401
+    named_scope,
+    span,
+    telemetry_active,
+)

@@ -1,0 +1,188 @@
+"""Collective audit — jaxpr-level census of a step function's wire cost.
+
+This generalizes what ``benchmarks/allreduce_bench.py`` grew ad hoc: for
+any traceable function (a jitted train step, a communicator's
+``allreduce_grad``), count the collective primitives it lowers to and
+charge each collective's per-device operand bytes to the mesh axes it
+runs over.  The result is environment-independent evidence of an
+algorithm's wire structure — readable on one chip, or on the virtual
+CPU mesh, long before a v4-32 is available — and the input the
+two_dimensional backend's bandwidth claim is verified against (its
+inter-axis bytes must be the flat backend's divided by ``intra_size``).
+
+``benchmarks/allreduce_bench.py`` and ``bench.py``'s
+``allreduce_static_bytes_per_leg`` table now consume THIS module (one
+source of truth for the bytes-per-leg metric); examples call
+:func:`audit_fn` on their real train step and log the result as an
+``hlo_audit`` row in the step-event log.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import numpy as np
+
+# lax.psum → psum, lax.psum_scatter → reduce_scatter, lax.all_gather →
+# all_gather, lax.ppermute → ppermute, lax.all_to_all → all_to_all.
+COLLECTIVE_PRIMITIVES = (
+    "psum", "reduce_scatter", "all_gather", "ppermute", "all_to_all",
+)
+
+# The four the gradient-allreduce census reports (all_to_all never appears
+# in an allreduce lowering; kept out for byte-identical bench output).
+ALLREDUCE_CENSUS_KEYS = ("psum", "reduce_scatter", "all_gather", "ppermute")
+
+
+def _eqn_axes(eqn):
+    """Mesh-axis names a collective eqn runs over, as a tuple."""
+    for key in ("axes", "axis_name"):
+        if key in eqn.params:
+            ax = eqn.params[key]
+            if isinstance(ax, (tuple, list)):
+                out = []
+                for a in ax:
+                    out.extend(a) if isinstance(a, (tuple, list)) \
+                        else out.append(a)
+                return tuple(out)
+            return (ax,)
+    return ()
+
+
+def _operand_bytes(eqn) -> int:
+    """Per-device operand bytes of one eqn (sum over array invars)."""
+    return sum(
+        int(np.prod(v.aval.shape)) * np.dtype(v.aval.dtype).itemsize
+        for v in eqn.invars
+        if hasattr(v.aval, "shape")
+    )
+
+
+def iter_eqns(jaxpr):
+    """Depth-first walk over every eqn, recursing into inner jaxprs
+    (pjit/shard_map/scan/cond bodies) — collectives live inside the
+    shard_map eqn, never at top level."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for val in eqn.params.values():
+            # Inner jaxprs appear as raw Jaxpr (has .eqns) or ClosedJaxpr
+            # (has .jaxpr) param values; `branches` holds a tuple of them.
+            if isinstance(val, (tuple, list)):
+                for v in val:
+                    if hasattr(v, "eqns"):
+                        yield from iter_eqns(v)
+                    elif hasattr(v, "jaxpr"):
+                        yield from iter_eqns(v.jaxpr)
+            elif hasattr(val, "eqns"):
+                yield from iter_eqns(val)
+            elif hasattr(val, "jaxpr"):
+                yield from iter_eqns(val.jaxpr)
+
+
+@dataclasses.dataclass
+class CollectiveAudit:
+    """Census of one traced program's collectives.
+
+    ``counts`` — occurrences per collective primitive name.
+    ``bytes_per_axis`` — per-device operand bytes charged to each mesh
+    axis a collective runs over (an op over both axes charges both),
+    ``str(axis) → bytes``.
+    ``bytes_per_primitive`` — per-device operand bytes per primitive.
+    """
+
+    counts: Dict[str, int]
+    bytes_per_axis: Dict[str, int]
+    bytes_per_primitive: Dict[str, int]
+
+    def census(self, keys=ALLREDUCE_CENSUS_KEYS) -> Dict[str, int]:
+        """Fixed-key count view (zeros included) — the allreduce-bench
+        ``hlo_collectives`` record shape."""
+        return {k: self.counts.get(k, 0) for k in keys}
+
+    def summary(self) -> dict:
+        return {
+            "counts": dict(self.counts),
+            "bytes_per_axis": dict(self.bytes_per_axis),
+            "bytes_per_primitive": dict(self.bytes_per_primitive),
+        }
+
+
+def audit_jaxpr(jaxpr) -> CollectiveAudit:
+    """Audit an already-traced (Closed)Jaxpr."""
+    if hasattr(jaxpr, "jaxpr"):  # ClosedJaxpr
+        jaxpr = jaxpr.jaxpr
+    counts: Dict[str, int] = {}
+    per_axis: Dict[str, int] = {}
+    per_prim: Dict[str, int] = {}
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name not in COLLECTIVE_PRIMITIVES:
+            continue
+        counts[name] = counts.get(name, 0) + 1
+        nbytes = _operand_bytes(eqn)
+        per_prim[name] = per_prim.get(name, 0) + nbytes
+        for ax in _eqn_axes(eqn):
+            per_axis[str(ax)] = per_axis.get(str(ax), 0) + nbytes
+    return CollectiveAudit(counts, per_axis, per_prim)
+
+
+def audit_fn(fn, *args, **kwargs) -> CollectiveAudit:
+    """Trace ``fn(*args, **kwargs)`` (jitted or plain — ``make_jaxpr``
+    traces through ``jit``) and audit the resulting program.  Args may
+    be real arrays or ``jax.ShapeDtypeStruct``s; nothing executes."""
+    import jax
+
+    return audit_jaxpr(jax.make_jaxpr(fn)(*args, **kwargs))
+
+
+def _allreduce_jaxpr(comm, nbytes: int, dtype):
+    """The traced ``allreduce_grad`` lowering every per-communicator
+    census is computed on: a rank-stacked (device_size, elems) buffer
+    through the communicator's characteristic collective pattern."""
+    import jax
+    import jax.numpy as jnp
+
+    n = comm.device_size
+    elems = max(1, nbytes // np.dtype(dtype).itemsize)
+    spec = comm._world_spec
+
+    def body(tree):
+        sq = jax.tree.map(lambda x: jnp.squeeze(x, 0), tree)
+        out = comm.allreduce_grad(sq)
+        return jax.tree.map(lambda x: x[None], out)
+
+    return jax.make_jaxpr(comm.shard_map(
+        body, in_specs=({"g": spec},), out_specs={"g": spec}
+    ))({"g": jnp.ones((n, elems), dtype)})
+
+
+def audit_allreduce(comm, nbytes: int, dtype=np.float32) -> CollectiveAudit:
+    """Audit one communicator's gradient-allreduce path at a given
+    per-device payload — the library home of bench.py's
+    ``allreduce_static_bytes_per_leg`` numbers."""
+    return audit_jaxpr(_allreduce_jaxpr(comm, nbytes, dtype))
+
+
+def assert_two_dimensional_inter_savings(profiles: dict,
+                                         intra_size: int) -> None:
+    """``profiles``: {communicator_name: bytes_per_axis dict}.  Asserts
+    the 2D claim when both sides are present: two_dimensional's
+    inter-axis operand bytes == flat's / intra_size (SURVEY §2.1
+    two-dimensional row — the reference's rationale for the 2D algorithm
+    on >1 GbE clusters)."""
+    flat = next(
+        (profiles[k] for k in ("flat", "xla_ici", "pure_nccl")
+         if k in profiles), None,
+    )
+    td = profiles.get("two_dimensional")
+    if flat is None or td is None:
+        return
+    flat_inter = flat.get("inter", 0)
+    td_inter = td.get("inter", 0)
+    assert flat_inter > 0 and td_inter > 0, (profiles,)
+    assert td_inter * intra_size == flat_inter, (
+        f"two_dimensional inter-axis bytes {td_inter} x intra "
+        f"{intra_size} != flat's {flat_inter} — the 2D bandwidth claim "
+        "does not hold in the traced lowering"
+    )

@@ -44,6 +44,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from chainermn_tpu.communicators.base import CommunicatorBase
+from chainermn_tpu.observability.spans import named_scope
 
 
 def _check_batch_divisibility(batch, n_dev, n_accum=1):
@@ -56,6 +57,36 @@ def _check_batch_divisibility(batch, n_dev, n_accum=1):
                 f"pad or drop the remainder (see datasets.toy.batch_iterator "
                 f"drop_last)"
             )
+
+
+def _instrument_step(step_fn):
+    """Telemetry wrapper for a built train step: when a Reporter or
+    StepRecorder is installed (``observability.telemetry_active``) each
+    call runs under ``span("train_step")`` — profiler annotation +
+    host-side duration into both sinks — and bumps the reporter's
+    ``train_step_calls`` counter.  With no telemetry installed the cost
+    is one boolean check, so steps stay wrappable unconditionally."""
+    from chainermn_tpu.observability import spans as _spans
+
+    @functools.wraps(step_fn)
+    def instrumented(*args, **kwargs):
+        if not _spans.telemetry_active():
+            return step_fn(*args, **kwargs)
+        from chainermn_tpu.observability import reporter as _rep
+
+        with _spans.span("train_step"):
+            out = step_fn(*args, **kwargs)
+        rep = _rep.get_reporter()
+        if rep is not None:
+            rep.count("train_step_calls")
+        return out
+
+    # Keep jit's AOT surface reachable (bench.py lowers the step for
+    # XLA's cost model); plain-function steps just skip this.
+    for attr in ("lower", "eval_shape", "trace"):
+        if hasattr(step_fn, attr):
+            setattr(instrumented, attr, getattr(step_fn, attr))
+    return instrumented
 
 
 def flat_shard_state_spec(optimizer, shard_size: int, world):
@@ -335,28 +366,31 @@ class MultiNodeOptimizer:
     def _accum_local_grads(self, one, params, batch, base_key, n_accum):
         """Scan the microbatches, accumulating FULL local gradient trees
         (stages 0 and 1).  Returns (mean_loss, stacked_aux, mean_grads)."""
-        if n_accum == 1:
-            loss, aux, grads = one(
-                params, batch, base_key
+        with named_scope("fwd-bwd"):
+            if n_accum == 1:
+                loss, aux, grads = one(
+                    params, batch, base_key
+                )
+                return loss, aux, grads
+
+            micro = self._split_micro(batch, n_accum)
+
+            def mb(carry, xs):
+                gacc, lacc = carry
+                i, b = xs
+                key = (None if base_key is None
+                       else jax.random.fold_in(base_key, i))
+                loss, aux, grads = one(params, b, key)
+                gacc = jax.tree.map(jnp.add, gacc, grads)
+                return (gacc, lacc + loss), aux
+
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            (gacc, lsum), auxs = lax.scan(
+                mb, (zeros, jnp.zeros((), jnp.float32)),
+                (jnp.arange(n_accum), micro)
             )
-            return loss, aux, grads
-
-        micro = self._split_micro(batch, n_accum)
-
-        def mb(carry, xs):
-            gacc, lacc = carry
-            i, b = xs
-            key = None if base_key is None else jax.random.fold_in(base_key, i)
-            loss, aux, grads = one(params, b, key)
-            gacc = jax.tree.map(jnp.add, gacc, grads)
-            return (gacc, lacc + loss), aux
-
-        zeros = jax.tree.map(jnp.zeros_like, params)
-        (gacc, lsum), auxs = lax.scan(
-            mb, (zeros, jnp.zeros((), jnp.float32)), (jnp.arange(n_accum), micro)
-        )
-        grads = jax.tree.map(lambda g: g / n_accum, gacc)
-        return lsum / n_accum, auxs, grads
+            grads = jax.tree.map(lambda g: g / n_accum, gacc)
+            return lsum / n_accum, auxs, grads
 
     def _apply_update(self, params, state, grads, loss_scale=None):
         """Allreduce local grads and apply the inner optimizer — the shared
@@ -371,7 +405,8 @@ class MultiNodeOptimizer:
         comm = self.communicator
         opt = self.actual_optimizer
         if self.double_buffering:
-            new_mean = comm.allreduce_grad(grads)
+            with named_scope("allreduce"):
+                new_mean = comm.allreduce_grad(grads)
             stale = state.comm_buf
 
             def do_update(operand):
@@ -381,20 +416,23 @@ class MultiNodeOptimizer:
                 updates, inner = opt.update(stale, inner, params)
                 return optax.apply_updates(params, updates), inner
 
-            params, inner = lax.cond(
-                state.step > 0,
-                do_update,
-                lambda operand: (operand[0], operand[1]),
-                (params, state.inner, stale),
-            )
+            with named_scope("opt-update"):
+                params, inner = lax.cond(
+                    state.step > 0,
+                    do_update,
+                    lambda operand: (operand[0], operand[1]),
+                    (params, state.inner, stale),
+                )
             return params, MultiNodeOptimizerState(
                 inner=inner, step=state.step + 1, comm_buf=new_mean
             )
-        grads = comm.allreduce_grad(grads)
+        with named_scope("allreduce"):
+            grads = comm.allreduce_grad(grads)
         if loss_scale is not None:
             grads = jax.tree.map(lambda g: g / loss_scale, grads)
-        updates, inner = opt.update(grads, state.inner, params)
-        params = optax.apply_updates(params, updates)
+        with named_scope("opt-update"):
+            updates, inner = opt.update(grads, state.inner, params)
+            params = optax.apply_updates(params, updates)
         return params, MultiNodeOptimizerState(
             inner=inner, step=state.step + 1, comm_buf=()
         )
@@ -509,13 +547,13 @@ class MultiNodeOptimizer:
         if n_accum < 1:
             raise ValueError(f"n_accum must be >= 1, got {n_accum}")
         if self.zero_stage in (1, 2):
-            return self._make_zero_train_step(
+            return _instrument_step(self._make_zero_train_step(
                 loss_fn, batch_spec, donate, has_aux, rng, n_accum, loss_scale
-            )
+            ))
         if self.zero_stage == 3:
-            return self._make_zero3_train_step(
+            return _instrument_step(self._make_zero3_train_step(
                 loss_fn, batch_spec, donate, has_aux, rng, n_accum, loss_scale
-            )
+            ))
         one = self._make_micro_grad_fn(loss_fn, has_aux, loss_scale)
 
         def body(params, state, batch):
@@ -545,7 +583,7 @@ class MultiNodeOptimizer:
             _check_batch_divisibility(batch, n_dev, n_accum)
             return jitted(params, state, batch)
 
-        return step
+        return _instrument_step(step)
 
     def _scatter_grads(self, grads, shard_size, n, world):
         """Pack a full local gradient tree and reduce-scatter it to this
@@ -554,9 +592,11 @@ class MultiNodeOptimizer:
         gflat, _ = self._zero_pack(grads, shard_size * n)
         if comm.allreduce_grad_dtype is not None:
             gflat = gflat.astype(comm.allreduce_grad_dtype)
-        return (
-            lax.psum_scatter(gflat, world, scatter_dimension=0, tiled=True) / n
-        ).astype(jnp.float32)
+        with named_scope("allreduce"):
+            gshard = lax.psum_scatter(
+                gflat, world, scatter_dimension=0, tiled=True
+            ) / n
+        return gshard.astype(jnp.float32)
 
     def _accum_scattered_grads(
         self, one, params, batch, base_key, n_accum, shard_size, n, world
@@ -776,9 +816,9 @@ class MultiNodeOptimizer:
             return loss, new_model_state, grads
 
         if self.zero_stage > 0:
-            return self._make_zero_with_state_step(
+            return _instrument_step(self._make_zero_with_state_step(
                 grads_and_state, batch_spec, donate
-            )
+            ))
 
         def body(params, state, model_state, batch):
             loss, new_model_state, grads = grads_and_state(
@@ -793,7 +833,7 @@ class MultiNodeOptimizer:
             out_specs=(P(),) * 4,
         )
         donate_argnums = (0, 1, 2) if donate else ()
-        return jax.jit(mapped, donate_argnums=donate_argnums)
+        return _instrument_step(jax.jit(mapped, donate_argnums=donate_argnums))
 
     def _make_zero_with_state_step(self, grads_and_state, batch_spec, donate):
         """ZeRO tails for the with-model-state step.  Stages 1/2 are

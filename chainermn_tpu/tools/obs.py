@@ -1,0 +1,241 @@
+"""Step-event log summarizer/exporter CLI.
+
+Reads one or more JSONL step-event logs written by
+``chainermn_tpu.observability.StepRecorder`` (rotated segments included,
+truncated crash tails skipped) and either prints a JSON summary or
+exports Prometheus textfile metrics.
+
+Usage::
+
+    # one JSON object: steps/sec, loss curve, span totals, compile
+    # events, collective counts (multi-rank logs aggregate per step):
+    python -m chainermn_tpu.tools.obs summarize steps.jsonl
+
+    # several ranks' logs together (values rank-aggregate):
+    python -m chainermn_tpu.tools.obs summarize r0.jsonl r1.jsonl
+
+    # Prometheus textfile (node_exporter textfile-collector format):
+    python -m chainermn_tpu.tools.obs prom steps.jsonl -o steps.prom
+
+The summary's rank aggregation mirrors the Reporter's reductions: losses
+average across ranks per step (each rank already logs the pmean'd global
+loss, so the aggregate of N rank logs matches a single-process run),
+counters and span durations sum, step timing averages.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def _load(paths, include_rotated=True) -> List[dict]:
+    from chainermn_tpu.observability.step_log import read_records
+
+    rows: List[dict] = []
+    for p in paths:
+        rows.extend(read_records(p, include_rotated=include_rotated))
+    return rows
+
+
+def _median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2] if xs else None
+
+
+def summarize(rows: List[dict], curve_points: int = 16) -> dict:
+    """Pure aggregation over parsed rows — the CLI's engine, exposed for
+    tests and in-process use."""
+    events: Dict[str, int] = {}
+    for r in rows:
+        e = r.get("event", "?")
+        events[e] = events.get(e, 0) + 1
+
+    steps = [r for r in rows if r.get("event") == "step"]
+    ranks = sorted({int(r.get("rank", 0)) for r in rows})
+    n_ranks = max(1, len(ranks))
+
+    # Per-(step index) rank aggregation: mean loss/dt across ranks.
+    by_step: Dict[int, List[dict]] = {}
+    for r in steps:
+        by_step.setdefault(int(r.get("step", 0)), []).append(r)
+
+    def rank_mean(rs, key):
+        vs = [float(r[key]) for r in rs if key in r]
+        return sum(vs) / len(vs) if vs else None
+
+    step_ids = sorted(by_step)
+    dts = [d for s in step_ids
+           if (d := rank_mean(by_step[s], "dt")) is not None]
+    losses = [(s, l) for s in step_ids
+              if (l := rank_mean(by_step[s], "loss")) is not None]
+    items = sum(r.get("items", 0) for r in steps) / n_ranks
+
+    out: dict = {"rows": len(rows), "events": events, "ranks": ranks}
+    summary_steps: dict = {"count": len(step_ids)}
+    if dts:
+        wall = sum(dts)
+        summary_steps.update(
+            wall_s=wall,
+            mean_dt_s=wall / len(dts),
+            median_dt_s=_median(dts),
+            per_sec=len(dts) / wall if wall > 0 else 0.0,
+        )
+        if items:
+            summary_steps["items_per_sec"] = items / wall if wall else 0.0
+    out["steps"] = summary_steps
+
+    if losses:
+        stride = max(1, -(-len(losses) // curve_points))
+        curve = losses[::stride]
+        if curve[-1] != losses[-1]:
+            curve.append(losses[-1])
+        out["loss"] = {
+            "first": losses[0][1],
+            "last": losses[-1][1],
+            "min": min(l for _, l in losses),
+            "curve": [[s, l] for s, l in curve],
+        }
+
+    spans: Dict[str, dict] = {}
+    for r in steps:
+        for name, secs in (r.get("spans") or {}).items():
+            d = spans.setdefault(name, {"total_s": 0.0, "count": 0})
+            d["total_s"] += float(secs)
+            d["count"] += 1
+    if spans:
+        out["spans"] = spans
+
+    compiles = [r for r in rows if r.get("event") == "compile"]
+    if compiles:
+        out["compile"] = {
+            "count": len(compiles),
+            "total_s": sum(float(r.get("secs", 0.0)) for r in compiles),
+        }
+
+    audits = [r for r in rows if r.get("event") == "hlo_audit"]
+    if audits:
+        counts: Dict[str, int] = {}
+        per_axis: Dict[str, int] = {}
+        for r in audits:
+            for k, v in (r.get("counts") or {}).items():
+                counts[k] = counts.get(k, 0) + int(v)
+            for k, v in (r.get("bytes_per_axis") or {}).items():
+                per_axis[k] = per_axis.get(k, 0) + int(v)
+        # An audit is a static property of the step program: every rank
+        # logs the same census, so report the per-rank view.
+        n_audit_ranks = max(
+            1, len({int(r.get("rank", 0)) for r in audits})
+        )
+        out["collectives"] = {
+            "counts": {k: v // n_audit_ranks for k, v in counts.items()},
+            "bytes_per_axis": {
+                k: v // n_audit_ranks for k, v in per_axis.items()
+            },
+        }
+    return out
+
+
+def _fmt(v: float) -> str:
+    return f"{float(v):.10g}"
+
+
+def to_prometheus(summary: dict, prefix: str = "chainermn_tpu") -> str:
+    """Render a summary as Prometheus textfile metrics (deterministic
+    ordering — fit for golden-file tests and textfile collectors)."""
+    lines: List[str] = []
+
+    def metric(name, mtype, help_, samples):
+        lines.append(f"# HELP {prefix}_{name} {help_}")
+        lines.append(f"# TYPE {prefix}_{name} {mtype}")
+        for labels, value in samples:
+            lab = (
+                "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
+                if labels else ""
+            )
+            lines.append(f"{prefix}_{name}{lab} {_fmt(value)}")
+
+    st = summary.get("steps", {})
+    metric("steps_total", "counter", "Training steps recorded",
+           [((), st.get("count", 0))])
+    if "wall_s" in st:
+        metric("step_seconds_sum", "counter",
+               "Sum of host-side step durations", [((), st["wall_s"])])
+        metric("step_seconds_mean", "gauge", "Mean step duration",
+               [((), st["mean_dt_s"])])
+        metric("steps_per_second", "gauge", "Steps per second",
+               [((), st["per_sec"])])
+    if "items_per_sec" in st:
+        metric("items_per_second", "gauge",
+               "Items (tokens or images) per second",
+               [((), st["items_per_sec"])])
+    loss = summary.get("loss")
+    if loss:
+        metric("loss_last", "gauge", "Last recorded loss",
+               [((), loss["last"])])
+        metric("loss_min", "gauge", "Minimum recorded loss",
+               [((), loss["min"])])
+    comp = summary.get("compile")
+    if comp:
+        metric("compile_events_total", "counter",
+               "jax.monitoring compile events", [((), comp["count"])])
+        metric("compile_seconds_total", "counter",
+               "Total compile seconds", [((), comp["total_s"])])
+    spans = summary.get("spans")
+    if spans:
+        metric("span_seconds_total", "counter",
+               "Host-side span durations",
+               [((("span", k),), v["total_s"])
+                for k, v in sorted(spans.items())])
+    coll = summary.get("collectives")
+    if coll:
+        metric("collective_ops_total", "counter",
+               "Collective primitives in the audited step program",
+               [((("primitive", k),), v)
+                for k, v in sorted(coll["counts"].items())])
+        metric("collective_operand_bytes", "gauge",
+               "Per-device collective operand bytes per mesh axis",
+               [((("axis", k),), v)
+                for k, v in sorted(coll["bytes_per_axis"].items())])
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m chainermn_tpu.tools.obs",
+        description="Summarize/export StepRecorder JSONL logs.",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("summarize", help="print one JSON summary object")
+    s.add_argument("logs", nargs="+", help="JSONL log path(s), one per rank")
+    s.add_argument("--no-rotated", action="store_true",
+                   help="ignore rotated .N segments")
+    s.add_argument("--curve-points", type=int, default=16,
+                   help="max loss-curve samples in the summary")
+
+    p = sub.add_parser("prom", help="export Prometheus textfile metrics")
+    p.add_argument("logs", nargs="+")
+    p.add_argument("-o", "--output", default=None,
+                   help="output path (default: stdout)")
+    p.add_argument("--prefix", default="chainermn_tpu")
+    p.add_argument("--no-rotated", action="store_true")
+
+    args = ap.parse_args(argv)
+    rows = _load(args.logs, include_rotated=not args.no_rotated)
+    if args.cmd == "summarize":
+        print(json.dumps(summarize(rows, curve_points=args.curve_points)))
+        return 0
+    text = to_prometheus(summarize(rows), prefix=args.prefix)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,12 +19,16 @@ import jax
 
 def setup_compilation_cache(cache_dir: Optional[str] = None) -> None:
     """Point JAX's persistent compilation cache at ``cache_dir`` (default:
-    ``<repo>/.jax_cache``).  Big step functions over this environment's
-    remote-compile tunnel are slow to compile; sharing one on-disk cache
-    across bench/test/example entry points makes re-runs start in
-    seconds.  Call before the first jit; a no-op on failure."""
+    ``$CHAINERMN_TPU_JAX_CACHE``, else ``<repo>/.jax_cache``).  Big step
+    functions over this environment's remote-compile tunnel are slow to
+    compile; sharing one on-disk cache across bench/test/example entry
+    points makes re-runs start in seconds.  Call before the first jit; a
+    no-op on failure.  The env override exists for installed trees and
+    multi-checkout machines, where a repo-relative path is wrong."""
     import os
 
+    if cache_dir is None:
+        cache_dir = os.environ.get("CHAINERMN_TPU_JAX_CACHE")
     if cache_dir is None:
         cache_dir = os.path.join(
             os.path.dirname(os.path.dirname(os.path.dirname(
@@ -92,17 +96,42 @@ def sync(tree):
 
 @contextlib.contextmanager
 def trace(logdir: str = "/tmp/chainermn_tpu_trace"):
-    """Capture a device-level profiler trace around the with-block."""
-    jax.profiler.start_trace(logdir)
+    """Capture a device-level profiler trace around the with-block.
+
+    Degrades to a timing-only no-op (the with-block still runs, the
+    logdir is still yielded) when ``jax.profiler`` is unavailable or the
+    backend refuses to start a trace — stripped jax builds and PJRT
+    plugins without profiler support must not take down a training run
+    that merely asked for visibility."""
+    prof = getattr(jax, "profiler", None)
+    started = False
+    if prof is not None and hasattr(prof, "start_trace"):
+        try:
+            prof.start_trace(logdir)
+            started = True
+        except Exception:
+            pass
     try:
         yield logdir
     finally:
-        jax.profiler.stop_trace()
+        if started:
+            try:
+                prof.stop_trace()
+            except Exception:
+                pass
 
 
 def annotate(name: str):
-    """Named region for profiler timelines (usable as context manager)."""
-    return jax.profiler.TraceAnnotation(name)
+    """Named region for profiler timelines (usable as context manager).
+    A null context when ``jax.profiler`` is unavailable, so span-heavy
+    code (``observability.span``) runs unchanged on stripped builds."""
+    prof = getattr(jax, "profiler", None)
+    if prof is None or not hasattr(prof, "TraceAnnotation"):
+        return contextlib.nullcontext()
+    try:
+        return prof.TraceAnnotation(name)
+    except Exception:
+        return contextlib.nullcontext()
 
 
 class StepTimer:

@@ -17,6 +17,8 @@ data.
 """
 
 import argparse
+import contextlib
+import json
 import time
 
 import jax
@@ -63,6 +65,11 @@ def main(argv=None):
                    help="save a generation every N global steps")
     p.add_argument("--checkpoint-name", default="imagenet",
                    help="checkpoint set name under --checkpoint-dir")
+    p.add_argument("--step-log", default=None, metavar="PATH",
+                   help="write a JSONL step-event log (per-step timing, "
+                        "loss, compile events, device memory, one "
+                        "hlo_audit row); summarize with `python -m "
+                        "chainermn_tpu.tools.obs summarize PATH`")
     args = p.parse_args(argv)
 
     comm = chainermn_tpu.create_communicator(args.communicator)
@@ -153,6 +160,20 @@ def main(argv=None):
 
     evaluator = Evaluator(metric_fn, comm)
 
+    # --step-log: opt-in telemetry for the whole run.  Note the per-step
+    # float(loss) readback below serializes host and device — leave the
+    # flag off when chasing headline img/s.
+    telemetry = contextlib.ExitStack()
+    reporter = recorder = None
+    if args.step_log:
+        from chainermn_tpu import observability as obs
+
+        reporter = obs.Reporter()
+        telemetry.enter_context(obs.scope(reporter))
+        recorder = telemetry.enter_context(
+            obs.StepRecorder(args.step_log, rank=comm.rank)
+        )
+
     # Fault tolerance (reference: REF:examples' checkpointer usage +
     # REF:chainermn/extensions/checkpoint.py): a crashed/killed run
     # relaunched with the same command line resumes from the newest
@@ -222,6 +243,13 @@ def main(argv=None):
             gb = (batch[0], batch[1])
             if comm.size > 1:
                 gb = comm.global_batch(gb)
+            if recorder is not None and gstep == 0:
+                from chainermn_tpu import observability as obs
+
+                a = obs.audit_fn(getattr(step, "__wrapped__", step),
+                                 params, state, batch_stats, gb)
+                recorder.record("hlo_audit", counts=a.counts,
+                                bytes_per_axis=a.bytes_per_axis)
             params, state, batch_stats, loss = step(
                 params, state, batch_stats, gb
             )
@@ -229,6 +257,9 @@ def main(argv=None):
             n_steps += 1
             gstep += 1
             last_loss = loss
+            if recorder is not None:
+                recorder.step(step=gstep - 1, items=gb[0].shape[0],
+                              loss=float(loss), epoch=epoch)
             if ckpt is not None and gstep % args.checkpoint_every == 0:
                 ckpt.save(
                     {"params": params, "state": state,
@@ -261,6 +292,11 @@ def main(argv=None):
             print(
                 f"final gstep {gstep} params_digest {tree_digest(params):08x}"
             )
+    if reporter is not None:
+        agg = reporter.aggregate(comm)
+        if comm.rank == 0:
+            print("telemetry: " + json.dumps(agg))
+    telemetry.close()
     return params, batch_stats
 
 

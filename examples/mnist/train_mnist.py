@@ -19,6 +19,8 @@ CPU-mesh smoke run (8 virtual devices):
 """
 
 import argparse
+import contextlib
+import json
 import time
 
 import jax
@@ -46,6 +48,12 @@ def main():
                         "--double-buffering)")
     p.add_argument("--train-size", type=int, default=8192)
     p.add_argument("--val-size", type=int, default=1024)
+    p.add_argument("--step-log", default=None, metavar="PATH",
+                   help="write a JSONL step-event log (per-step loss, "
+                        "timing, compile events, one hlo_audit row); "
+                        "summarize with `python -m chainermn_tpu.tools.obs "
+                        "summarize PATH`.  Multi-process runs should "
+                        "point each rank at its own file.")
     args = p.parse_args()
 
     comm = chainermn_tpu.create_communicator(args.communicator)
@@ -87,14 +95,41 @@ def main():
     step = opt.make_train_step(loss_fn)
     evaluator = Evaluator(metric_fn, comm)
 
+    # --step-log: install a Reporter + StepRecorder for the whole run.
+    # The instrumented step and the evaluator publish into them; the
+    # per-step float(loss) readback below is the example's choice of
+    # fidelity over async dispatch.
+    telemetry = contextlib.ExitStack()
+    reporter = recorder = None
+    if args.step_log:
+        from chainermn_tpu import observability as obs
+
+        reporter = obs.Reporter()
+        telemetry.enter_context(obs.scope(reporter))
+        recorder = telemetry.enter_context(
+            obs.StepRecorder(args.step_log, rank=comm.rank)
+        )
+
+    global_step = 0
     for epoch in range(args.epochs):
         t0 = time.perf_counter()
         n_seen = 0
         last_loss = float("nan")
         for batch in batch_iterator(train, args.batchsize, seed=epoch):
+            if recorder is not None and global_step == 0:
+                # Audit the unwrapped jitted step once: the collective
+                # census of the program the whole run executes.
+                a = obs.audit_fn(getattr(step, "__wrapped__", step),
+                                 params, state, batch)
+                recorder.record("hlo_audit", counts=a.counts,
+                                bytes_per_axis=a.bytes_per_axis)
             params, state, loss = step(params, state, batch)
             n_seen += batch[0].shape[0]
             last_loss = loss
+            if recorder is not None:
+                recorder.step(step=global_step, items=batch[0].shape[0],
+                              loss=float(loss), epoch=epoch)
+            global_step += 1
         sync(last_loss)  # host readback: honest timing on all backends
         dt = time.perf_counter() - t0
 
@@ -111,6 +146,11 @@ def main():
                 + "  ".join(f"{k} {v:.4f}" for k, v in metrics.items())
                 + f"  ({ips:,.0f} img/s)"
             )
+    if reporter is not None:
+        agg = reporter.aggregate(comm)
+        if comm.rank == 0:
+            print("telemetry: " + json.dumps(agg))
+    telemetry.close()
     return params, metrics
 
 

@@ -346,6 +346,27 @@ def main():
     after = create_communicator("naive")
     assert after.bcast_obj({"post": "split"}, root=0)["post"] == "split"
 
+    # Reporter cross-host aggregation over the REAL multi-process object
+    # plane: rank-dependent observations must merge to the same
+    # observation-weighted totals on every rank.
+    from chainermn_tpu.observability import Reporter
+
+    rep = Reporter()
+    rep.observe("loss", float(pid))       # one observation per rank
+    rep.observe("loss", float(pid) + 1.0)
+    rep.count("steps", pid + 1)
+    rep.histogram_observe("lat", 2.0 ** pid)
+    agg = rep.aggregate(after)
+    n = after.size
+    loss = agg["scalars"]["loss"]
+    assert loss["count"] == 2 * n, loss
+    # sum over ranks of (pid + pid+1) = 2*sum(pid) + n
+    assert loss["sum"] == float(n * (n - 1) + n), loss
+    assert loss["min"] == 0.0 and loss["max"] == float(n), loss
+    assert agg["counters"]["steps"] == n * (n + 1) // 2, agg["counters"]
+    # 2^pid lands in bucket pid (ceil(log2) with 2^0=1 -> bucket 0).
+    assert sum(agg["histograms"]["lat"].values()) == n, agg["histograms"]
+
     print(f"MP_WORKER_OK {pid}", flush=True)
 
 

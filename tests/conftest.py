@@ -35,6 +35,54 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 import pytest  # noqa: E402
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# Where a telemetry file accidentally written with a relative path would
+# land during the suite (tests run with cwd = repo root).
+_LEAK_SCAN_DIRS = (
+    _REPO_ROOT,
+    os.path.join(_REPO_ROOT, "tests"),
+    os.path.join(_REPO_ROOT, "examples"),
+    os.path.join(_REPO_ROOT, "benchmarks"),
+)
+_LEAK_PATTERNS = (".jsonl", ".prom")
+
+
+def _telemetry_files():
+    found = set()
+    for d in _LEAK_SCAN_DIRS:
+        try:
+            names = os.listdir(d)
+        except OSError:
+            continue
+        for n in names:
+            if n.endswith(_LEAK_PATTERNS) or ".jsonl." in n:
+                found.add(os.path.join(d, n))
+    return found
+
+
+@pytest.fixture(autouse=True)
+def _no_telemetry_leaks():
+    """Fail any test that leaves a step log / Prometheus export outside
+    tmp: StepRecorder paths in tests must go through tmp_path.  (Scan is
+    non-recursive over the repo root and the dirs tests use as cwd —
+    cheap enough to run autouse.)"""
+    before = _telemetry_files()
+    yield
+    leaked = _telemetry_files() - before
+    assert not leaked, (
+        "test leaked telemetry files into the repo (write them under "
+        f"tmp_path instead): {sorted(leaked)}"
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    """Soak tests (long recorder/rotation runs) stay out of tier-1: any
+    test with 'soak' in its name gets the ``slow`` marker implicitly, so
+    forgetting the decorator cannot slow the gate."""
+    for item in items:
+        if "soak" in item.name:
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture(scope="session")
 def devices8():

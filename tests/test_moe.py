@@ -9,10 +9,9 @@ from jax.sharding import PartitionSpec as P
 from chainermn_tpu.communicators import build_mesh
 from chainermn_tpu.parallel.moe import dense_moe_oracle, moe_layer, top1_route
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+# Version-compat wrapper: forwards check_vma under whichever
+# replication-check kwarg spelling this jax accepts.
+from chainermn_tpu.communicators.base import shard_map_compat as shard_map
 
 E, D, T_PER_DEV = 4, 8, 16
 

@@ -13,10 +13,9 @@ from chainermn_tpu.parallel.pipeline import (
     spmd_pipeline,
 )
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+# Version-compat wrapper: forwards check_vma under whichever
+# replication-check kwarg spelling this jax accepts.
+from chainermn_tpu.communicators.base import shard_map_compat as shard_map
 
 
 N_STAGES = 4

@@ -11,10 +11,9 @@ from chainermn_tpu.communicators import build_mesh
 from chainermn_tpu.parallel.ring_attention import ring_attention
 from chainermn_tpu.parallel.ulysses import ulysses_attention
 
-try:  # jax >= 0.4.35
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+# Version-compat wrapper: forwards check_vma under whichever
+# replication-check kwarg spelling this jax accepts.
+from chainermn_tpu.communicators.base import shard_map_compat as shard_map
 
 
 def full_attention(q, k, v, causal=True):

@@ -136,10 +136,9 @@ def test_param_spec_rejects_unmatched_naming():
 # ---------------------------------------------------------------------------
 
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+# Version-compat wrapper: forwards check_vma under whichever
+# replication-check kwarg spelling this jax accepts.
+from chainermn_tpu.communicators.base import shard_map_compat as shard_map
 
 
 @pytest.fixture(scope="module")
