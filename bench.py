@@ -472,6 +472,7 @@ def main(argv=None):
         out = bench_resnet(comm, args)
         out["lm"] = bench_lm(comm, args)
         out["allreduce_static_bytes_per_leg"] = _static_allreduce_table()
+        out["allreduce_tree"] = _allreduce_tree_table()
     if recorder is not None:
         recorder.step()  # flush buffered compile events and step spans
         recorder.record("bench_result", result=out)
@@ -512,6 +513,45 @@ def _static_allreduce_table():
              "--communicators",
              "flat,two_dimensional,hierarchical,xla_ici,naive",
              "--sizes-mb", "4"],
+            capture_output=True, text=True, timeout=300, env=env,
+        )
+        if proc.returncode != 0:
+            return {"error": proc.stderr.strip()[-500:]}
+        return [json.loads(line) for line in proc.stdout.splitlines()
+                if line.startswith("{")]
+    except Exception as e:  # pragma: no cover - environment-specific
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _allreduce_tree_table():
+    """Many-leaf gradient-tree allreduce: bucketed (GradPacker fusion,
+    communicators/packing.py) vs unbucketed lowering of a 64-leaf
+    mixed-shape tree per communicator, in the same CPU-mesh subprocess
+    idiom as :func:`_static_allreduce_table`.  Static-only: the pinned
+    evidence is the collective census becoming independent of leaf count
+    (reduction ops per dtype bucket, not per leaf) and the per-bucket
+    operand bytes; timing a virtual CPU mesh would prove nothing about
+    ICI."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks", "allreduce_bench.py",
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, script, "--static-only",
+             "--tree-leaves", "64", "--tree-total-mb", "8",
+             "--communicators",
+             "flat,two_dimensional,hierarchical,xla_ici,naive"],
             capture_output=True, text=True, timeout=300, env=env,
         )
         if proc.returncode != 0:

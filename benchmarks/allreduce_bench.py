@@ -149,6 +149,90 @@ def bench_one(comm, nbytes: int, dtype, iters: int, warmup: int) -> dict:
     }
 
 
+def _time_tree(comm, stacked, iters: int, warmup: int) -> float:
+    """Seconds per eager_allreduce_grad over a stacked tree (chained
+    serial dependency; same sync discipline as :func:`bench_one`)."""
+    import jax
+
+    from chainermn_tpu.utils.profiling import sync
+
+    out = stacked
+    for _ in range(warmup):
+        out = comm.eager_allreduce_grad(out)
+    sync(out)
+    if jax.default_backend() == "cpu":
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = comm.eager_allreduce_grad(out)
+            sync(out)
+        return (time.perf_counter() - t0) / iters
+    from chainermn_tpu.utils.profiling import slope_time
+
+    def run(k):
+        nonlocal out
+        t0 = time.perf_counter()
+        for _ in range(k):
+            out = comm.eager_allreduce_grad(out)
+        sync(out)
+        return time.perf_counter() - t0
+
+    return slope_time(run, iters)
+
+
+def bench_tree(name: str, n_leaves: int, total_bytes: int, dtype,
+               iters: int, warmup: int, bucket_bytes: int | None,
+               static_only: bool) -> dict:
+    """The many-leaf ``allreduce_tree`` row: bucketed (GradPacker fusion)
+    vs unbucketed (``bucket_bytes=0``) lowering of the SAME mixed-shape
+    gradient tree through one communicator — collective census, per-axis
+    and per-bucket operand bytes, and (unless ``static_only``) timings.
+    """
+    import jax
+
+    import chainermn_tpu
+    from chainermn_tpu.communicators.packing import (
+        DEFAULT_BUCKET_BYTES,
+        GradPacker,
+        synthetic_grad_tree,
+    )
+    from chainermn_tpu.observability.hlo_audit import audit_allreduce_tree
+
+    bb = DEFAULT_BUCKET_BYTES if bucket_bytes is None else int(bucket_bytes)
+    tree = synthetic_grad_tree(n_leaves, total_bytes, dtypes=(str(dtype),))
+    row: dict = {
+        "metric": "allreduce_tree",
+        "communicator": name,
+        "n_leaves": n_leaves,
+        "payload_bytes": sum(
+            l.size * l.dtype.itemsize for l in jax.tree_util.tree_leaves(tree)
+        ),
+        "bucket_bytes": bb,
+        "packing": GradPacker.for_tree(tree, bucket_bytes=bb).describe(),
+    }
+    for label, cap in (("bucketed", bb), ("unbucketed", 0)):
+        comm = chainermn_tpu.create_communicator(name, bucket_bytes=cap)
+        audit = audit_allreduce_tree(comm, tree)
+        entry = {
+            "hlo_collectives": audit.census(),
+            "reduction_collectives": audit.reduction_collectives(),
+            "per_axis_operand_bytes": audit.bytes_per_axis,
+            "op_bytes": {k: v for k, v in audit.op_bytes.items()},
+        }
+        if not static_only:
+            n = comm.device_size
+            stacked = jax.tree_util.tree_map(
+                lambda l: jnp.stack([jnp.asarray(l)] * n), tree
+            )
+            dt = _time_tree(comm, stacked, iters, warmup)
+            entry["time_ms"] = round(dt * 1e3, 4)
+        row[label] = entry
+    tb = row["bucketed"].get("time_ms")
+    tu = row["unbucketed"].get("time_ms")
+    if tb and tu:
+        row["speedup_vs_unbucketed"] = round(tu / tb, 4)
+    return row
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--communicators", default="xla_ici",
@@ -165,6 +249,16 @@ def main():
                          "assert the two_dimensional inter-leg savings "
                          "claim (runs on any backend, incl. the virtual "
                          "CPU mesh)")
+    ap.add_argument("--tree-leaves", type=int, default=0,
+                    help="many-leaf mode: bench allreduce_grad over a "
+                         "synthetic mixed-shape gradient tree with this "
+                         "many leaves, bucketed vs unbucketed (0 = the "
+                         "classic single-buffer sweep)")
+    ap.add_argument("--tree-total-mb", type=float, default=8.0,
+                    help="total payload of the synthetic tree in MiB")
+    ap.add_argument("--bucket-bytes", type=int, default=None,
+                    help="bucket cap for the tree mode's bucketed "
+                         "variant (default: the 4 MiB packing default)")
     args = ap.parse_args()
     if args.iters < 1:
         ap.error("--iters must be >= 1")
@@ -179,6 +273,16 @@ def main():
     import chainermn_tpu
 
     dtype = jnp.dtype(args.dtype)
+    if args.tree_leaves > 0:
+        total_bytes = int(args.tree_total_mb * 2**20)
+        for name in args.communicators.split(","):
+            row = bench_tree(
+                name.strip(), args.tree_leaves, total_bytes, dtype,
+                args.iters, args.warmup, args.bucket_bytes,
+                args.static_only,
+            )
+            print(json.dumps(row))
+        return
     if args.static_only:
         nbytes = int(float(args.sizes_mb.split(",")[0]) * 2**20)
         profiles = {}

@@ -34,8 +34,9 @@ from .naive import NaiveCommunicator
 from .single_host import SingleHostCommunicator, SingleNodeCommunicator
 from .two_dimensional import TwoDimensionalCommunicator
 from .xla_ici import FlatCommunicator, XlaIciCommunicator
-from . import mesh_utils
+from . import mesh_utils, packing
 from .mesh_utils import build_mesh
+from .packing import DEFAULT_BUCKET_BYTES, GradPacker, pack_tree
 
 _COMMUNICATORS: dict[str, type[CommunicatorBase]] = {
     "naive": NaiveCommunicator,
@@ -56,6 +57,8 @@ def create_communicator(
     allreduce_grad_dtype: Any | None = None,
     inter_size: int | None = None,
     intra_size: int | None = None,
+    bucket_bytes: int | None = None,
+    scatter_inter: bool = False,
 ) -> CommunicatorBase:
     """Create a communicator by name (reference signature:
     ``create_communicator(communicator_name='hierarchical', mpi_comm=None,
@@ -64,6 +67,14 @@ def create_communicator(
     ``mesh`` defaults to the full-slice ``(inter, intra)`` mesh;
     ``inter_size``/``intra_size`` force a factorization (testing analogue of
     running ``mpiexec -n 2`` on one box, SURVEY §4).
+
+    ``bucket_bytes`` caps the fused gradient-allreduce buckets (see
+    :mod:`chainermn_tpu.communicators.packing` and docs/performance.md):
+    ``None`` resolves env override → tuned value → 4 MiB default, ``0``
+    disables bucketing (legacy per-leaf lowering), ``>0`` is an explicit
+    cap.  ``scatter_inter`` (hierarchical only) decomposes its intra leg
+    into reduce-scatter/all-gather so the inter (DCN) hop moves
+    ``1/intra_size`` of the bytes.
     """
     try:
         cls = _COMMUNICATORS[communicator_name]
@@ -74,7 +85,17 @@ def create_communicator(
         ) from None
     if mesh is None:
         mesh = build_mesh(inter_size=inter_size, intra_size=intra_size)
-    return cls(mesh, allreduce_grad_dtype=allreduce_grad_dtype)
+    kwargs: dict = dict(
+        allreduce_grad_dtype=allreduce_grad_dtype, bucket_bytes=bucket_bytes
+    )
+    if scatter_inter:
+        if not issubclass(cls, HierarchicalCommunicator):
+            raise ValueError(
+                "scatter_inter is only meaningful for the hierarchical "
+                f"communicator, not {communicator_name!r}"
+            )
+        kwargs["scatter_inter"] = True
+    return cls(mesh, **kwargs)
 
 
 __all__ = [
@@ -89,4 +110,8 @@ __all__ = [
     "create_communicator",
     "build_mesh",
     "mesh_utils",
+    "packing",
+    "GradPacker",
+    "pack_tree",
+    "DEFAULT_BUCKET_BYTES",
 ]

@@ -53,7 +53,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from . import kvtransport, mesh_utils
+from . import kvtransport, mesh_utils, packing
 
 try:  # jax >= 0.4.35
     from jax import shard_map as _shard_map_impl
@@ -115,7 +115,12 @@ def _warn_ppermute_fallback(world: int) -> None:
 def _tree_cast(tree, dtype):
     if dtype is None:
         return tree
-    return jax.tree.map(lambda x: x.astype(dtype), tree)
+    # Skip leaves already at the target dtype: a no-op astype still emits
+    # a convert_element_type into the jaxpr, inflating the hlo_audit
+    # census (and the compiler's work) for nothing.
+    return jax.tree.map(
+        lambda x: x if x.dtype == dtype else x.astype(dtype), tree
+    )
 
 
 class CommunicatorBase:
@@ -137,6 +142,7 @@ class CommunicatorBase:
         axes: Sequence[str] | None = None,
         allreduce_grad_dtype: Any | None = None,
         host_members: Sequence[int] | None = None,
+        bucket_bytes: int | None = None,
     ):
         # Subgroup membership (``split(color, key)``): the ordered GLOBAL
         # process indices participating in this communicator's host plane.
@@ -166,6 +172,17 @@ class CommunicatorBase:
         self.allreduce_grad_dtype = (
             jnp.dtype(allreduce_grad_dtype) if allreduce_grad_dtype else None
         )
+        # Gradient bucketing cap (chainermn_tpu.communicators.packing):
+        # None = resolve at call time (env override -> tuned -> default),
+        # 0 = bucketing off (the legacy per-leaf/one-buffer lowering),
+        # >0 = explicit per-bucket payload cap in bytes.
+        if bucket_bytes is not None:
+            bucket_bytes = int(bucket_bytes)
+            if bucket_bytes < 0:
+                raise ValueError(
+                    f"bucket_bytes must be >= 0, got {bucket_bytes}"
+                )
+        self.bucket_bytes = bucket_bytes
         # Host-plane transport context.  Communicator construction is SPMD
         # (every process builds the same communicators in the same order —
         # the same contract MPI_Comm_create relies on), so a class-level
@@ -511,15 +528,108 @@ class CommunicatorBase:
         ``size`` (mean), which every subclass here preserves.  Subclasses
         implement `_allreduce_impl` with their characteristic collective
         pattern; this wrapper handles the optional low-precision cast
-        (``allreduce_grad_dtype``).
+        (``allreduce_grad_dtype``) and, for multi-leaf trees, the bucketed
+        flat-buffer packing (:mod:`chainermn_tpu.communicators.packing`)
+        that turns O(n_leaves) collectives into O(n_buckets) — the
+        reference ``pure_nccl`` fusion generalized to every variant.
+        Single-leaf trees take the direct path unchanged, and
+        ``bucket_bytes=0`` (or ``CHAINERMN_TPU_BUCKET_BYTES=0``) restores
+        the legacy unbucketed lowering.
         """
+        leaves = jax.tree.leaves(tree)
+        if not leaves:
+            return tree
         dtypes = jax.tree.map(lambda x: x.dtype, tree)
         tree = _tree_cast(tree, self.allreduce_grad_dtype)
-        out = self._allreduce_impl(tree)
-        return jax.tree.map(lambda x, d: x.astype(d), out, dtypes)
+        bb = self.resolve_bucket_bytes(tree) if len(leaves) > 1 else 0
+        if bb > 0:
+            out = self._allreduce_bucketed(tree, bb)
+        else:
+            out = self._allreduce_impl(tree)
+        return jax.tree.map(
+            lambda x, d: x if x.dtype == d else x.astype(d), out, dtypes
+        )
 
     def _allreduce_impl(self, tree):
         raise NotImplementedError
+
+    def resolve_bucket_bytes(self, tree=None) -> int:
+        """Effective bucket cap for one ``allreduce_grad`` call.
+
+        Resolution order: the constructor's ``bucket_bytes`` if set; else
+        the ``CHAINERMN_TPU_BUCKET_BYTES`` environment override; else a
+        tuned value from the persistent tune cache (TPU runtime only —
+        inert under pytest and off-TPU, like every tuning lookup); else
+        :data:`packing.DEFAULT_BUCKET_BYTES`.  Returns 0 when bucketing
+        is disabled.
+        """
+        bb = self.bucket_bytes
+        if bb is None:
+            env = os.environ.get(packing.ENV_BUCKET_BYTES, "").strip()
+            if env:
+                try:
+                    bb = int(env)
+                except ValueError:
+                    bb = None
+        if bb is None and tree is not None:
+            bb = self._tuned_bucket_bytes(tree)
+        if bb is None:
+            bb = packing.DEFAULT_BUCKET_BYTES
+        return max(int(bb), 0)
+
+    def _tuned_bucket_bytes(self, tree):
+        try:
+            from chainermn_tpu.tuning.autotune import lookup_bucket_bytes
+        except Exception:  # pragma: no cover - tuning subsystem absent
+            return None
+        leaves = jax.tree.leaves(tree)
+        per_dtype: dict = {}
+        for l in leaves:
+            dt = np.dtype(l.dtype)
+            per_dtype[dt] = per_dtype.get(dt, 0) + int(l.size) * dt.itemsize
+        dominant = max(per_dtype, key=per_dtype.get)
+        return lookup_bucket_bytes(
+            total_bytes=sum(per_dtype.values()),
+            n_leaves=len(leaves),
+            dtype=dominant,
+            communicator=self.name,
+        )
+
+    def _allreduce_bucketed(self, tree, bucket_bytes: int):
+        """One characteristic ``_allreduce_impl`` per contiguous per-dtype
+        bucket.  Pack/unpack are pure layout moves (ravel/concat/slice),
+        so they commute exactly with the elementwise-linear collectives
+        every subclass lowers to — bucketed and unbucketed results are
+        identical up to the collective's own dtype arithmetic."""
+        packer = packing.GradPacker.for_tree(tree, bucket_bytes=bucket_bytes)
+        self._report_packing(packer)
+        from chainermn_tpu.observability.spans import named_scope
+
+        with named_scope("grad-pack"):
+            bufs = packer.pack(tree)
+        outs = [self._allreduce_impl(b) for b in bufs]
+        with named_scope("grad-unpack"):
+            return packer.unpack(outs)
+
+    def _report_packing(self, packer) -> None:
+        """Publish the packing plan to the Reporter — at TRACE time (the
+        plan is static; a jitted step re-publishes only when retraced)."""
+        from chainermn_tpu.observability import reporter as _reporter
+        from chainermn_tpu.observability import spans as _spans
+
+        if not _spans.telemetry_active():
+            return
+        rep = _reporter.get_reporter()
+        if rep is None:  # pragma: no cover - raced deactivation
+            return
+        rep.count("grad_pack/traces")
+        rep.count("grad_pack/leaves", packer.n_leaves)
+        rep.count("grad_pack/buckets", packer.n_buckets)
+        rep.count("grad_pack/payload_bytes", packer.payload_bytes)
+        rep.count(
+            "grad_pack/pad_bytes", packer.padded_bytes - packer.payload_bytes
+        )
+        rep.histogram_observe("grad_pack/bucket_bytes", packer.bucket_bytes)
 
     def multi_node_mean(self, tree):
         """Alias matching later reference spellings of allreduce_grad."""
@@ -875,6 +985,7 @@ class CommunicatorBase:
                 self.mesh, axes=axes,
                 allreduce_grad_dtype=self.allreduce_grad_dtype,
                 host_members=self._hp_members,
+                bucket_bytes=self.bucket_bytes,
             )
         except ValueError:
             CommunicatorBase._plane_count = count
@@ -884,6 +995,7 @@ class CommunicatorBase:
                 self.mesh, axes=axes,
                 allreduce_grad_dtype=self.allreduce_grad_dtype,
                 host_members=self._hp_members,
+                bucket_bytes=self.bucket_bytes,
             )
 
     def split_devices(self, colors, keys=None) -> dict:
@@ -953,6 +1065,7 @@ class CommunicatorBase:
                 submesh,
                 allreduce_grad_dtype=self.allreduce_grad_dtype,
                 host_members=procs,
+                bucket_bytes=self.bucket_bytes,
             )
         return out
 
@@ -1017,6 +1130,7 @@ class CommunicatorBase:
                 submesh,
                 allreduce_grad_dtype=self.allreduce_grad_dtype,
                 host_members=members,
+                bucket_bytes=self.bucket_bytes,
             )
         except ValueError:
             CommunicatorBase._plane_count = count
@@ -1026,6 +1140,7 @@ class CommunicatorBase:
                 submesh,
                 allreduce_grad_dtype=self.allreduce_grad_dtype,
                 host_members=members,
+                bucket_bytes=self.bucket_bytes,
             )
 
     def __repr__(self):

@@ -20,6 +20,7 @@ original rather than imitating it.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
 from . import mesh_utils
@@ -27,20 +28,36 @@ from .base import CommunicatorBase
 
 
 class HierarchicalCommunicator(CommunicatorBase):
+    """``scatter_inter=False`` (default) is the faithful 3-phase
+    translation: two chained full-size psums, so every chip ships the
+    WHOLE buffer across the inter (DCN) axis — intra-reduced, but not
+    sharded.  ``scatter_inter=True`` decomposes the intra leg into
+    ``psum_scatter → psum(inter) → all_gather``: the same math (a psum is
+    definitionally reduce-scatter + all-gather), but the inter hop now
+    carries only ``1/intra_size`` of the bytes per chip — closing the
+    inter-leg gap BENCH_r05 measured against two_dimensional (4 MiB vs
+    512 KiB at intra=8) while keeping the per-leaf phase structure that
+    distinguishes this variant from the flat-packed 2-D communicator."""
+
     name = "hierarchical"
 
     def __init__(self, mesh=None, axes=None, allreduce_grad_dtype=None,
-                 host_members=None):
+                 host_members=None, bucket_bytes=None,
+                 scatter_inter: bool = False):
         super().__init__(mesh, axes, allreduce_grad_dtype,
-                         host_members=host_members)
+                         host_members=host_members,
+                         bucket_bytes=bucket_bytes)
         if mesh_utils.AXIS_INTRA not in self.axes or mesh_utils.AXIS_INTER not in self.axes:
             raise ValueError(
                 "hierarchical communicator needs both 'inter' and 'intra' "
                 f"mesh axes; got {self.axes}"
             )
+        self.scatter_inter = bool(scatter_inter)
 
     def _allreduce_impl(self, tree):
         n = self.device_size
+        if self.scatter_inter:
+            return jax.tree.map(self._scatter_leg, tree)
 
         def leg(g):
             g = lax.psum(g, mesh_utils.AXIS_INTRA)   # NCCL reduce+bcast leg
@@ -48,3 +65,20 @@ class HierarchicalCommunicator(CommunicatorBase):
             return g / n
 
         return jax.tree.map(leg, tree)
+
+    def _scatter_leg(self, g):
+        k = self.intra_size
+        shape = g.shape
+        flat = g.reshape(-1)
+        size = flat.size
+        pad = (-size) % k
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+        shard = lax.psum_scatter(
+            flat, mesh_utils.AXIS_INTRA, scatter_dimension=0, tiled=True
+        )
+        shard = lax.psum(shard, mesh_utils.AXIS_INTER)
+        full = lax.all_gather(
+            shard, mesh_utils.AXIS_INTRA, axis=0, tiled=True
+        )
+        return full[:size].reshape(shape) / self.device_size

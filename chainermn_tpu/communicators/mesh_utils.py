@@ -76,6 +76,20 @@ def build_mesh(
     return Mesh(grid, axis_names)
 
 
+def axis_size_traced(name: str) -> int:
+    """Static size of a mesh axis from inside ``shard_map``.
+
+    ``jax.lax.axis_size`` only exists in newer jax releases; the portable
+    spelling is ``psum`` of the Python constant 1 over the axis, which
+    constant-folds to the axis size (an ``int``) without emitting a
+    collective.
+    """
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(name)
+    return jax.lax.psum(1, name)
+
+
 def flat_rank(axes: Sequence[str]):
     """Traced flattened rank over ``axes`` — usable inside ``shard_map``.
 
@@ -86,7 +100,7 @@ def flat_rank(axes: Sequence[str]):
     """
     idx = jax.lax.axis_index(axes[0])
     for name in axes[1:]:
-        idx = idx * jax.lax.axis_size(name) + jax.lax.axis_index(name)
+        idx = idx * axis_size_traced(name) + jax.lax.axis_index(name)
     return idx
 
 

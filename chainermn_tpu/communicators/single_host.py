@@ -21,9 +21,10 @@ class SingleHostCommunicator(CommunicatorBase):
     name = "single_host"
 
     def __init__(self, mesh=None, axes=None, allreduce_grad_dtype=None,
-                 host_members=None):
+                 host_members=None, bucket_bytes=None):
         super().__init__(mesh, axes, allreduce_grad_dtype,
-                         host_members=host_members)
+                         host_members=host_members,
+                         bucket_bytes=bucket_bytes)
         if self.inter_size != 1 and mesh_utils.AXIS_INTER in self.axes:
             raise ValueError(
                 "single_host communicator requires inter_size == 1 "

@@ -26,16 +26,17 @@ from jax import lax
 
 from . import mesh_utils
 from .base import CommunicatorBase
-from .xla_ici import pack
+from .packing import pack_tree as pack
 
 
 class TwoDimensionalCommunicator(CommunicatorBase):
     name = "two_dimensional"
 
     def __init__(self, mesh=None, axes=None, allreduce_grad_dtype=None,
-                 host_members=None):
+                 host_members=None, bucket_bytes=None):
         super().__init__(mesh, axes, allreduce_grad_dtype,
-                         host_members=host_members)
+                         host_members=host_members,
+                         bucket_bytes=bucket_bytes)
         if mesh_utils.AXIS_INTRA not in self.axes or mesh_utils.AXIS_INTER not in self.axes:
             raise ValueError(
                 "two_dimensional communicator needs both 'inter' and 'intra' "
@@ -47,7 +48,9 @@ class TwoDimensionalCommunicator(CommunicatorBase):
         if not leaves:
             return tree
         common = jnp.result_type(*[l.dtype for l in leaves])
-        casted = jax.tree.map(lambda x: x.astype(common), tree)
+        casted = jax.tree.map(
+            lambda x: x if x.dtype == common else x.astype(common), tree
+        )
         flat, unpack = pack(casted)
 
         k = self.intra_size
@@ -64,4 +67,7 @@ class TwoDimensionalCommunicator(CommunicatorBase):
 
         full = full[:n] / self.device_size
         out = unpack(full)
-        return jax.tree.map(lambda x, ref: x.astype(ref.dtype), out, tree)
+        return jax.tree.map(
+            lambda x, ref: x if x.dtype == ref.dtype else x.astype(ref.dtype),
+            out, tree,
+        )

@@ -30,29 +30,10 @@ from jax import lax
 
 from .base import CommunicatorBase
 
-
-def pack(tree):
-    """Flatten a pytree into (one 1-D buffer, unpack closure).
-
-    The analogue of ``pack_params`` in
-    REF:chainermn/communicators/_memory_utility.py — except XLA owns the
-    copies, so this is a trace-time concatenation the compiler fuses with
-    the collective rather than a runtime memcpy loop.
-    """
-    leaves, treedef = jax.tree.flatten(tree)
-    flat = jnp.concatenate([jnp.ravel(l) for l in leaves]) if leaves else jnp.zeros((0,))
-
-    shapes = [l.shape for l in leaves]
-    sizes = [l.size for l in leaves]
-
-    def unpack(buf):
-        out, off = [], 0
-        for shape, size in zip(shapes, sizes):
-            out.append(jnp.reshape(buf[off : off + size], shape))
-            off += size
-        return jax.tree.unflatten(treedef, out)
-
-    return flat, unpack
+# The flatten/concat core now lives in packing.py (shared with the
+# bucketed allreduce_grad path and the ZeRO flat-master buffers in
+# chainermn_tpu.optimizers); this name stays as the import surface.
+from .packing import pack_tree as pack
 
 
 class XlaIciCommunicator(CommunicatorBase):
@@ -66,11 +47,16 @@ class XlaIciCommunicator(CommunicatorBase):
         # when allreduce_grad_dtype is set; otherwise promote to the widest
         # leaf dtype so the single fused collective is well-typed).
         common = jnp.result_type(*[l.dtype for l in leaves])
-        casted = jax.tree.map(lambda x: x.astype(common), tree)
+        casted = jax.tree.map(
+            lambda x: x if x.dtype == common else x.astype(common), tree
+        )
         flat, unpack = pack(casted)
         flat = lax.psum(flat, self.axes) / self.device_size
         out = unpack(flat)
-        return jax.tree.map(lambda x, ref: x.astype(ref.dtype), out, tree)
+        return jax.tree.map(
+            lambda x, ref: x if x.dtype == ref.dtype else x.astype(ref.dtype),
+            out, tree,
+        )
 
 
 # ``flat`` is the CUDA-aware-MPI spelling of the same algorithm in the

@@ -39,6 +39,7 @@ from chainermn_tpu.observability.step_log import (  # noqa: F401
 from chainermn_tpu.observability.hlo_audit import (  # noqa: F401
     CollectiveAudit,
     audit_allreduce,
+    audit_allreduce_tree,
     audit_fn,
     audit_jaxpr,
 )

@@ -20,7 +20,7 @@ source of truth for the bytes-per-leg metric); examples call
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict
+from typing import Dict, List
 
 import numpy as np
 
@@ -29,6 +29,10 @@ import numpy as np
 COLLECTIVE_PRIMITIVES = (
     "psum", "reduce_scatter", "all_gather", "ppermute", "all_to_all",
 )
+
+#: The primitives that perform a reduction (the ones gradient bucketing
+#: promises to make leaf-count-independent; all_gather/ppermute only move).
+REDUCTION_PRIMITIVES = ("psum", "reduce_scatter")
 
 # The four the gradient-allreduce census reports (all_to_all never appears
 # in an allreduce lowering; kept out for byte-identical bench output).
@@ -89,22 +93,33 @@ class CollectiveAudit:
     axis a collective runs over (an op over both axes charges both),
     ``str(axis) → bytes``.
     ``bytes_per_primitive`` — per-device operand bytes per primitive.
+    ``op_bytes`` — per-device operand bytes of each individual occurrence,
+    in trace order per primitive: with gradient bucketing this IS the
+    per-bucket byte profile of the allreduce.
     """
 
     counts: Dict[str, int]
     bytes_per_axis: Dict[str, int]
     bytes_per_primitive: Dict[str, int]
+    op_bytes: Dict[str, List[int]] = dataclasses.field(default_factory=dict)
 
     def census(self, keys=ALLREDUCE_CENSUS_KEYS) -> Dict[str, int]:
         """Fixed-key count view (zeros included) — the allreduce-bench
         ``hlo_collectives`` record shape."""
         return {k: self.counts.get(k, 0) for k in keys}
 
+    def reduction_collectives(self) -> int:
+        """Total reduction-collective occurrences (psum + reduce_scatter)
+        — the count bucketing makes O(n_buckets) instead of O(n_leaves)."""
+        return sum(self.counts.get(k, 0) for k in REDUCTION_PRIMITIVES)
+
     def summary(self) -> dict:
         return {
             "counts": dict(self.counts),
             "bytes_per_axis": dict(self.bytes_per_axis),
             "bytes_per_primitive": dict(self.bytes_per_primitive),
+            "op_bytes": {k: list(v) for k, v in self.op_bytes.items()},
+            "reduction_collectives": self.reduction_collectives(),
         }
 
 
@@ -115,6 +130,7 @@ def audit_jaxpr(jaxpr) -> CollectiveAudit:
     counts: Dict[str, int] = {}
     per_axis: Dict[str, int] = {}
     per_prim: Dict[str, int] = {}
+    op_bytes: Dict[str, List[int]] = {}
     for eqn in iter_eqns(jaxpr):
         name = eqn.primitive.name
         if name not in COLLECTIVE_PRIMITIVES:
@@ -122,9 +138,10 @@ def audit_jaxpr(jaxpr) -> CollectiveAudit:
         counts[name] = counts.get(name, 0) + 1
         nbytes = _operand_bytes(eqn)
         per_prim[name] = per_prim.get(name, 0) + nbytes
+        op_bytes.setdefault(name, []).append(nbytes)
         for ax in _eqn_axes(eqn):
             per_axis[str(ax)] = per_axis.get(str(ax), 0) + nbytes
-    return CollectiveAudit(counts, per_axis, per_prim)
+    return CollectiveAudit(counts, per_axis, per_prim, op_bytes)
 
 
 def audit_fn(fn, *args, **kwargs) -> CollectiveAudit:
@@ -162,6 +179,36 @@ def audit_allreduce(comm, nbytes: int, dtype=np.float32) -> CollectiveAudit:
     per-device payload — the library home of bench.py's
     ``allreduce_static_bytes_per_leg`` numbers."""
     return audit_jaxpr(_allreduce_jaxpr(comm, nbytes, dtype))
+
+
+def audit_allreduce_tree(comm, tree) -> CollectiveAudit:
+    """Audit ``allreduce_grad`` over a FULL gradient pytree.
+
+    ``tree`` carries per-device leaf shapes (no leading rank axis) —
+    arrays or ``jax.ShapeDtypeStruct``s; nothing executes.  This is the
+    many-leaf generalization of :func:`audit_allreduce`: with bucketing
+    on, ``reduction_collectives()`` is O(n_buckets) and ``op_bytes``
+    holds each bucket's wire size; with ``bucket_bytes=0`` it shows the
+    legacy per-leaf lowering for comparison.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = comm.device_size
+    spec = comm._world_spec
+    stacked = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct((n,) + tuple(l.shape), l.dtype), tree
+    )
+    specs = jax.tree.map(lambda _: spec, stacked)
+
+    def body(t):
+        sq = jax.tree.map(lambda x: jnp.squeeze(x, 0), t)
+        out = comm.allreduce_grad(sq)
+        return jax.tree.map(lambda x: x[None], out)
+
+    return audit_jaxpr(jax.make_jaxpr(comm.shard_map(
+        body, in_specs=(specs,), out_specs=specs
+    ))(stacked))
 
 
 def assert_two_dimensional_inter_savings(profiles: dict,

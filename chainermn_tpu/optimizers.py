@@ -205,14 +205,16 @@ class MultiNodeOptimizer:
         return n, total, (total + pad) // n
 
     def _zero_pack(self, tree, padded_size):
-        from chainermn_tpu.communicators.xla_ici import pack
+        from chainermn_tpu.communicators.packing import pack_tree
 
-        flat, unpack = pack(jax.tree.map(lambda x: x.astype(jnp.float32), tree))
-        if flat.size < padded_size:
-            flat = jnp.concatenate(
-                [flat, jnp.zeros((padded_size - flat.size,), flat.dtype)]
-            )
-        return flat, unpack
+        return pack_tree(
+            jax.tree.map(
+                lambda x: x if x.dtype == jnp.float32
+                else x.astype(jnp.float32),
+                tree,
+            ),
+            pad_to=padded_size,
+        )
 
     def _zero_inner_spec(self, shard_size):
         return flat_shard_state_spec(

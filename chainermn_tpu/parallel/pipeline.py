@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..communicators.mesh_utils import axis_size_traced
+
 
 def spmd_pipeline(
     stage_fn: Callable,
@@ -45,7 +47,7 @@ def spmd_pipeline(
     Returns (B, ...) final-stage outputs, valid on the LAST stage (zeros
     elsewhere); broadcast if every stage needs them.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size_traced(axis_name)
     idx = lax.axis_index(axis_name)
     B = x.shape[0]
     if B % n_microbatches:
@@ -137,7 +139,7 @@ def pipeline_1f1b_loss_and_grads(
       stage 0 (psum before use) — to feed an embedding's ``jax.vjp``
       outside the schedule.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size_traced(axis_name)
     idx = lax.axis_index(axis_name)
     M = n_microbatches
     B = x.shape[0]
@@ -313,7 +315,7 @@ def pipeline_interleaved_1f1b_loss_and_grads(
     ``stage_grads`` carries the ``(v, ...)`` chunk axis of
     ``stage_params``.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size_traced(axis_name)
     idx = lax.axis_index(axis_name)
     v = n_chunks
     M = n_microbatches
@@ -516,7 +518,7 @@ def spmd_pipeline_circular(
     Returns ``(B, ...)`` final-stage outputs in microbatch order, valid on
     the LAST device (zeros elsewhere).
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size_traced(axis_name)
     idx = lax.axis_index(axis_name)
     M = n_microbatches
     v = n_chunks
@@ -599,7 +601,7 @@ def pipeline_circular_1f1b_loss_and_grads(
     ``(n-1)/(v*M)`` at ``O(M*v)`` saved activations.  Use the coupled
     explicit-vjp scheduler when the activation footprint binds instead.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size_traced(axis_name)
     idx = lax.axis_index(axis_name)
     M = n_microbatches
     B = x.shape[0]
@@ -662,7 +664,7 @@ def pipeline_forward_and_loss(
     trains all stages — each device materializing gradients only for ITS
     stage parameters.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size_traced(axis_name)
     idx = lax.axis_index(axis_name)
     out = spmd_pipeline(stage_fn, stage_params, x, axis_name, n_microbatches)
     local = jnp.where(idx == n - 1, loss_fn(out, target), 0.0)

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..communicators.mesh_utils import axis_size_traced
+
 
 def _block_attn(q, k, v, mask, scale):
     """One q-block × kv-block attention with unnormalized accumulators.
@@ -139,7 +141,7 @@ def ring_attention(
     numerically identical (up to fp32 accumulation order) to full
     attention over the gathered sequence.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size_traced(axis_name)
     my = lax.axis_index(axis_name)
     B, S, H, D = q.shape
     if scale is None:
@@ -309,7 +311,7 @@ def zigzag_ring_attention(
     the activations); they rotate with the K/V blocks, on both the dense
     inner path and the flash inner (the segmented flash-with-LSE kernel).
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size_traced(axis_name)
     my = lax.axis_index(axis_name)
     B, S, H, D = q.shape
     if S % 2:
@@ -443,7 +445,7 @@ def _local_seg_slice(segment_ids, axis_name, s_local, batch):
             f"shape {segment_ids.shape} — per-row (B, S) ids go to "
             "ring_attention/ulysses_attention directly (as LOCAL shards)"
         )
-    n = lax.axis_size(axis_name)
+    n = axis_size_traced(axis_name)
     if segment_ids.shape[0] != s_local * n:
         # dynamic_slice CLAMPS out-of-range starts — wrong-length ids
         # would silently give every shard the same trailing window.

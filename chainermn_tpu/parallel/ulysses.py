@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..communicators.mesh_utils import axis_size_traced
+
 
 def ulysses_attention(
     q: jax.Array,
@@ -51,7 +53,7 @@ def ulysses_attention(
     applies unchanged (ring/zigzag would need cross-shard band
     bookkeeping and deliberately reject it).
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size_traced(axis_name)
     B, S_loc, H, D = q.shape
     Hk = k.shape[2]
     if H % n:

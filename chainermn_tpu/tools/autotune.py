@@ -67,9 +67,24 @@ def main(argv=None) -> int:
                     help="sliding-window width (tunes the banded kernel)")
     ap.add_argument("--dtype", default="bfloat16",
                     choices=["bfloat16", "float32"])
+    # Gradient-allreduce bucket cap (docs/performance.md "Bucketed
+    # gradient allreduce").
+    ap.add_argument("--allreduce-bucket", action="store_true",
+                    help="also tune the gradient-allreduce bucket_bytes "
+                         "(communicators/packing.py)")
+    ap.add_argument("--ab-communicator", default="xla_ici",
+                    help="communicator variant to tune the bucket for")
+    ap.add_argument("--ab-total-mb", type=float, default=64.0,
+                    help="synthetic gradient tree size in MiB")
+    ap.add_argument("--ab-leaves", type=int, default=64,
+                    help="synthetic gradient tree leaf count")
     args = ap.parse_args(argv)
 
-    from chainermn_tpu.tuning import TuneCache, tune_lm_shapes
+    from chainermn_tpu.tuning import (
+        TuneCache,
+        tune_allreduce_bucket,
+        tune_lm_shapes,
+    )
 
     log = None if args.quiet else (lambda m: print(m, file=sys.stderr))
 
@@ -96,6 +111,14 @@ def main(argv=None) -> int:
     )
     for kernel in ("flash", "fused_ce"):
         print(json.dumps({kernel: out[kernel]}))
+    if args.allreduce_bucket:
+        rec = tune_allreduce_bucket(
+            communicator=args.ab_communicator, total_mb=args.ab_total_mb,
+            n_leaves=args.ab_leaves, dtype=args.dtype, cache=cache,
+            force=args.force, dry_run=args.dry_run, n1=args.n1,
+            repeats=args.repeats, log=log,
+        )
+        print(json.dumps({"allreduce_bucket": rec}))
     return 0
 
 

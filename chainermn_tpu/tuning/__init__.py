@@ -43,14 +43,18 @@ from chainermn_tpu.tuning.cache import (  # noqa: F401
     shared_cache,
 )
 from chainermn_tpu.tuning.search_space import (  # noqa: F401
+    bucket_cache_key,
+    bucket_search_space,
     ce_cache_key,
     ce_search_space,
     flash_cache_key,
     flash_search_space,
 )
 from chainermn_tpu.tuning.autotune import (  # noqa: F401
+    lookup_bucket_bytes,
     lookup_ce_chunk,
     lookup_flash_blocks,
+    tune_allreduce_bucket,
     tune_flash,
     tune_fused_ce,
     tune_lm_shapes,

@@ -27,6 +27,8 @@ from chainermn_tpu.tuning.cache import (
 )
 from chainermn_tpu.tuning.measure import best_config, measure_candidates
 from chainermn_tpu.tuning.search_space import (
+    bucket_cache_key,
+    bucket_search_space,
     ce_cache_key,
     ce_search_space,
     flash_cache_key,
@@ -96,6 +98,26 @@ def lookup_ce_chunk(*, N: int, V: int, D: int, dtype) -> Optional[int]:
     except Exception:
         return None
     return chunk if chunk >= 1 else None
+
+
+def lookup_bucket_bytes(*, total_bytes: int, n_leaves: int, dtype,
+                        communicator: str) -> Optional[int]:
+    """Tuned gradient-allreduce bucket cap for one (tree size, leaf
+    count, dominant dtype, communicator) family, or None (miss /
+    disabled).  ``0`` is a valid tuned answer: the measured winner was
+    the unbucketed path."""
+    if not runtime_lookup_enabled():
+        return None
+    try:
+        entry = shared_cache().get(bucket_cache_key(
+            device_kind(), dtype, total_bytes, n_leaves, communicator
+        ))
+        if not entry:
+            return None
+        bb = int(entry["bucket_bytes"])
+    except Exception:
+        return None
+    return bb if bb >= 0 else None
 
 
 # --------------------------------------------------------------------------
@@ -366,6 +388,93 @@ def tune_fused_ce(
          "N": N, "V": V, "D": D},
     )
     rec["kernel"] = "fused_ce"
+    return rec
+
+
+def tune_allreduce_bucket(
+    *,
+    communicator: str = "xla_ici",
+    total_mb: float = 64.0,
+    n_leaves: int = 64,
+    dtype="float32",
+    mesh=None,
+    cache: Optional[TuneCache] = None,
+    n1: int = 3,
+    repeats: int = 3,
+    force: bool = False,
+    dry_run: bool = False,
+    log: Optional[Callable[[str], None]] = None,
+) -> dict:
+    """Tune the gradient-allreduce ``bucket_bytes`` for one tree family.
+
+    Times ``eager_allreduce_grad`` over the shared synthetic mixed-shape
+    tree (``packing.synthetic_grad_tree``) at each candidate cap —
+    including 0, the unbucketed path — and persists the argmin under a
+    key the communicators' trace-time ``resolve_bucket_bytes`` lookup
+    reads back on TPU."""
+    import numpy as np
+
+    from chainermn_tpu.communicators.packing import (
+        DEFAULT_BUCKET_BYTES,
+        synthetic_grad_tree,
+    )
+
+    total_bytes = int(total_mb * 1024 * 1024)
+    tree = synthetic_grad_tree(n_leaves, total_bytes, dtypes=(dtype,))
+    total_bytes = sum(
+        l.size * l.dtype.itemsize for l in jax.tree_util.tree_leaves(tree)
+    )
+    space = bucket_search_space(total_bytes)
+    default_cfg = {"bucket_bytes": DEFAULT_BUCKET_BYTES}
+    key = bucket_cache_key(
+        device_kind(), dtype, total_bytes, n_leaves, communicator
+    )
+    if dry_run:
+        return {"kernel": "allreduce_bucket", "dry_run": True, "key": key,
+                "candidates": space, "default": default_cfg}
+    _require_tuning_allowed("allreduce bucketing")
+    cache = cache or shared_cache()
+    cached = cache.get(key) if not force else None
+    if cached and int(cached.get("bucket_bytes", -1)) >= 0:
+        return {"kernel": "allreduce_bucket", "key": key, "cached": True,
+                "chosen": {"bucket_bytes": int(cached["bucket_bytes"])}}
+
+    from chainermn_tpu.communicators import create_communicator
+    from chainermn_tpu.utils.profiling import sync
+
+    n = None  # filled by the first build
+    if log:
+        log(f"allreduce_bucket {key}: {len(space)} candidates")
+
+    def build(cfg):
+        nonlocal n
+        comm = create_communicator(
+            communicator, mesh=mesh, bucket_bytes=cfg["bucket_bytes"]
+        )
+        n = comm.device_size
+        stacked = jax.tree_util.tree_map(
+            lambda l: jax.numpy.stack([jax.numpy.asarray(l)] * n), tree
+        )
+
+        def run(k):
+            t0 = time.perf_counter()
+            out = stacked
+            for _ in range(k):
+                out = comm.eager_allreduce_grad(out)
+            sync(jax.tree_util.tree_leaves(out)[0])
+            return time.perf_counter() - t0
+
+        return run
+
+    results = measure_candidates(build, space, n1=n1, repeats=repeats,
+                                 log=log)
+    rec = _finish(
+        key, results, default_cfg, cache,
+        {"kernel": "allreduce_bucket", "dtype": dtype_name(dtype),
+         "communicator": communicator, "total_bytes": total_bytes,
+         "n_leaves": n_leaves, "device_size": n},
+    )
+    rec["kernel"] = "allreduce_bucket"
     return rec
 
 

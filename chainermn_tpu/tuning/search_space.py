@@ -177,3 +177,43 @@ def ce_cache_key(dev_kind: str, dtype, N: int, V: int, D: int) -> str:
         (("n", bucket_pow2(N)), ("v", V), ("d", D)),
         {},
     )
+
+
+#: candidate gradient-allreduce bucket caps: the pow2 ladder around the
+#: 4 MiB static default (chainermn_tpu.communicators.packing).
+BUCKET_BYTES_CANDIDATES = tuple((1 << 20) * m for m in (1, 2, 4, 8, 16, 32))
+
+
+def bucket_search_space(total_bytes: Optional[int] = None) -> List[dict]:
+    """Candidate ``{"bucket_bytes"}`` configs for the fused gradient
+    allreduce.  ``0`` (bucketing off — the legacy per-leaf/one-buffer
+    lowering) is always a candidate: for small trees one unbucketed
+    collective can win.  Caps beyond the first one covering the whole
+    tree are pruned (they all produce the same one-bucket-per-dtype
+    plan); the static default is always reachable."""
+    from chainermn_tpu.communicators.packing import DEFAULT_BUCKET_BYTES
+
+    out = [{"bucket_bytes": 0}]
+    for b in BUCKET_BYTES_CANDIDATES:
+        out.append({"bucket_bytes": b})
+        if total_bytes is not None and b >= total_bytes:
+            break
+    default = {"bucket_bytes": DEFAULT_BUCKET_BYTES}
+    if default not in out:
+        out.append(default)
+    return out
+
+
+def bucket_cache_key(dev_kind: str, dtype, total_bytes: int,
+                     n_leaves: int, communicator: str) -> str:
+    """Cache key for the allreduce bucket cap: total gradient bytes and
+    leaf count pow2-bucketed (the economics shift with both), dominant
+    dtype and communicator name exact (each variant's collective pattern
+    prices buckets differently)."""
+    return make_key(
+        "allreduce_bucket",
+        dev_kind,
+        dtype,
+        (("b", bucket_pow2(total_bytes)), ("l", bucket_pow2(n_leaves))),
+        {"comm": str(communicator)},
+    )

@@ -37,6 +37,10 @@ from chainermn_tpu.models.resnet import ResNet18, ResNet50
 def main(argv=None):
     p = argparse.ArgumentParser(description="chainermn_tpu ImageNet example")
     p.add_argument("--communicator", default="xla_ici")
+    p.add_argument("--bucket-bytes", type=int, default=None,
+                   help="gradient-allreduce bucket cap in bytes "
+                        "(0 disables bucketing; default: 4 MiB / "
+                        "CHAINERMN_TPU_BUCKET_BYTES — docs/performance.md)")
     p.add_argument("--arch", "--model", dest="arch", default="resnet50",
                    choices=["resnet50", "resnet18", "alex", "nin", "googlenet"],
                    help="model architecture (reference: train_imagenet.py --arch)")
@@ -72,7 +76,9 @@ def main(argv=None):
                         "chainermn_tpu.tools.obs summarize PATH`")
     args = p.parse_args(argv)
 
-    comm = chainermn_tpu.create_communicator(args.communicator)
+    comm = chainermn_tpu.create_communicator(
+        args.communicator, bucket_bytes=args.bucket_bytes
+    )
     if comm.rank == 0:
         print(f"communicator: {comm!r}")
 

@@ -38,6 +38,10 @@ from chainermn_tpu.models import MLP
 def main():
     p = argparse.ArgumentParser(description="chainermn_tpu MNIST example")
     p.add_argument("--communicator", default="xla_ici")
+    p.add_argument("--bucket-bytes", type=int, default=None,
+                   help="gradient-allreduce bucket cap in bytes "
+                        "(0 disables bucketing; default: 4 MiB / "
+                        "CHAINERMN_TPU_BUCKET_BYTES — docs/performance.md)")
     p.add_argument("--batchsize", type=int, default=256, help="global batch size")
     p.add_argument("--epochs", type=int, default=5)
     p.add_argument("--unit", type=int, default=1000)
@@ -56,7 +60,9 @@ def main():
                         "point each rank at its own file.")
     args = p.parse_args()
 
-    comm = chainermn_tpu.create_communicator(args.communicator)
+    comm = chainermn_tpu.create_communicator(
+        args.communicator, bucket_bytes=args.bucket_bytes
+    )
     if comm.rank == 0:  # reference pattern: only rank 0 logs
         print(f"communicator: {comm!r}")
         print(f"global batch {args.batchsize} over {comm.device_size} devices")

@@ -1,0 +1,136 @@
+"""Golden-file regression test for the allreduce collective census.
+
+Pins the jaxpr-level collective lowering (op counts, per-axis operand
+bytes, per-bucket op bytes) of ``allreduce_grad`` over the canonical
+64-leaf mixed-shape/mixed-dtype tree, per communicator, bucketed and
+unbucketed — so a refactor that silently changes the wire pattern (an
+extra psum per leaf, a lost scatter decomposition, a padding change)
+fails CI with a structural diff instead of shipping a bandwidth
+regression no single-host test can time.
+
+Regenerate after an INTENDED lowering change::
+
+    python tests/test_hlo_census_golden.py --regen
+
+then review the golden diff like any other code change.
+"""
+
+import json
+import os
+
+GOLDEN_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "golden", "allreduce_census_64leaf.json",
+)
+
+#: fixed scenario — must match the golden file's header.
+MESH_SHAPE = (2, 4)
+N_LEAVES = 64
+TOTAL_BYTES = 8 * 1024 * 1024
+BUCKET_BYTES = 256 * 1024
+
+COMMUNICATORS = ["naive", "flat", "xla_ici", "hierarchical",
+                 "two_dimensional"]
+
+
+def compute_census() -> dict:
+    """The current lowering's census for the pinned scenario (imports
+    inside so ``--regen`` can set platform env before jax loads)."""
+    import jax
+
+    from chainermn_tpu.communicators import build_mesh, create_communicator
+    from chainermn_tpu.communicators.packing import synthetic_grad_tree
+    from chainermn_tpu.observability import audit_allreduce_tree
+
+    devs = jax.devices()[: MESH_SHAPE[0] * MESH_SHAPE[1]]
+    mesh = build_mesh(
+        inter_size=MESH_SHAPE[0], intra_size=MESH_SHAPE[1], devices=devs
+    )
+    tree = synthetic_grad_tree(N_LEAVES, TOTAL_BYTES)
+    out = {
+        "mesh": list(MESH_SHAPE),
+        "n_leaves": N_LEAVES,
+        "total_bytes": TOTAL_BYTES,
+        "bucket_bytes": BUCKET_BYTES,
+        "communicators": {},
+    }
+    for name in COMMUNICATORS:
+        entry = {}
+        for label, cap in (("bucketed", BUCKET_BYTES), ("unbucketed", 0)):
+            comm = create_communicator(name, mesh=mesh, bucket_bytes=cap)
+            audit = audit_allreduce_tree(comm, tree)
+            entry[label] = {
+                "hlo_collectives": audit.census(),
+                "reduction_collectives": audit.reduction_collectives(),
+                "per_axis_operand_bytes": dict(
+                    sorted(audit.bytes_per_axis.items())
+                ),
+                "op_bytes": {k: list(v) for k, v in
+                             sorted(audit.op_bytes.items())},
+            }
+        out["communicators"][name] = entry
+    return out
+
+
+def test_collective_census_matches_golden():
+    with open(GOLDEN_PATH) as f:
+        golden = json.load(f)
+    current = compute_census()
+    for name in COMMUNICATORS:
+        for label in ("bucketed", "unbucketed"):
+            assert current["communicators"][name][label] == \
+                golden["communicators"][name][label], (
+                    f"{name}/{label} collective census drifted from the "
+                    f"golden file — if the lowering change is intended, "
+                    f"regenerate with: python {__file__} --regen"
+                )
+    assert current == golden
+
+
+def test_golden_file_internal_consistency():
+    """The golden numbers themselves must satisfy the ISSUE acceptance
+    bounds (guards against regenerating a golden that pins a bug)."""
+    with open(GOLDEN_PATH) as f:
+        golden = json.load(f)
+    from chainermn_tpu.communicators.packing import (
+        GradPacker,
+        synthetic_grad_tree,
+    )
+
+    tree = synthetic_grad_tree(N_LEAVES, TOTAL_BYTES)
+    plan = GradPacker.for_tree(tree, bucket_bytes=BUCKET_BYTES)
+    assert plan.n_leaves == N_LEAVES
+    for name, entry in golden["communicators"].items():
+        # <= 2 reduction collectives per dtype bucket, independent of the
+        # 64 leaves.
+        assert entry["bucketed"]["reduction_collectives"] <= 2 * plan.n_buckets
+        assert entry["bucketed"]["reduction_collectives"] < \
+            entry["unbucketed"]["reduction_collectives"] or name in (
+                "flat", "xla_ici", "two_dimensional"
+            )  # single-buffer backends already fuse the unbucketed tree
+
+
+if __name__ == "__main__":
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--regen", action="store_true",
+                    help="rewrite the golden file from the current lowering")
+    args = ap.parse_args()
+    if not args.regen:
+        ap.error("run under pytest, or pass --regen to regenerate")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+    census = compute_census()
+    with open(GOLDEN_PATH, "w") as f:
+        json.dump(census, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {GOLDEN_PATH}", file=sys.stderr)
