@@ -1,0 +1,40 @@
+"""Static collective-correctness linter for traced step programs.
+
+The static counterpart of :mod:`chainermn_tpu.observability`'s dynamic
+census: trace any step function (or take an existing jaxpr /
+``CollectiveAudit``) and evaluate a registry of rules — collective-order
+divergence (R001), unreduced gradients (R002), narrow-dtype reductions
+(R003), bucketing regressions (R004), missing buffer donation (R005) —
+producing structured findings *before* the first step runs.
+
+Surfaces:
+
+* library — :func:`analyze_fn` / :func:`analyze_jaxpr` /
+  :func:`assert_lint_clean`;
+* CLI — ``python -m chainermn_tpu.tools.lint`` (``--rules``,
+  ``--format json``, nonzero exit on error findings);
+* runtime hook — ``CHAINERMN_TPU_LINT=1`` lints a built train step at
+  its first call and reports through the Reporter/step log
+  (``CHAINERMN_TPU_LINT=strict`` raises instead);
+* pytest — the ``lint_clean`` fixture in ``tests/conftest.py``.
+
+Rule catalog and suppression (``# lint: disable=R00x``,
+``CHAINERMN_TPU_LINT_DISABLE``): docs/static_analysis.md.
+"""
+
+from chainermn_tpu.analysis.core import (  # noqa: F401
+    ENV_DISABLE,
+    Finding,
+    LintContext,
+    LintError,
+    LintReport,
+    Rule,
+    analyze_fn,
+    analyze_jaxpr,
+    assert_lint_clean,
+    collective_events,
+    collective_fingerprint,
+    list_rules,
+    register_rule,
+)
+from chainermn_tpu.analysis import rules  # noqa: F401  (registers R001–R005)

@@ -1,0 +1,479 @@
+"""Static collective-correctness linter — core engine.
+
+PRs 2–3 built the *dynamic* half of collective correctness: the
+``hlo_audit`` census and golden-census tests that pin what a traced step
+lowers to.  This module is the *static* half: trace any step function
+(or take an already-traced jaxpr / an existing ``CollectiveAudit``) and
+evaluate a registry of rules over it, producing structured findings
+before the first step ever runs.  The costliest distributed failure
+modes are not crashes but silently wrong or hung programs — ranks
+tracing divergent collective sequences (deadlock at dispatch), gradients
+consumed without an allreduce on the data-parallel axis (silent model
+divergence), reductions accumulating in bf16 (silent precision loss) —
+and all of them are visible in the jaxpr.
+
+Entry points:
+
+* :func:`analyze_fn` — trace ``fn(*args, **kwargs)`` (plain or jitted,
+  via the shared :func:`~chainermn_tpu.observability.hlo_audit.trace_step`)
+  and run the rules.  Nothing executes; args may be
+  ``jax.ShapeDtypeStruct``s.
+* :func:`analyze_jaxpr` — run the rules over an existing (Closed)Jaxpr
+  or a :class:`~chainermn_tpu.observability.hlo_audit.CollectiveAudit`
+  (rules that need the full jaxpr skip gracefully).
+* :func:`assert_lint_clean` — raise :class:`LintError` on any
+  error-severity finding; the shape pytest fixtures and CI gates want.
+
+Suppression: ``# lint: disable=R002`` comments in the step function's
+source, the ``disable=``/``rules=`` keyword allowlists, or the
+``CHAINERMN_TPU_LINT_DISABLE`` environment variable (comma-separated
+rule ids).  See docs/static_analysis.md for the rule catalog.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import inspect
+import json
+import os
+import re
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Iterator,
+    List,
+    NamedTuple,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+from chainermn_tpu.observability.hlo_audit import (
+    COLLECTIVE_PRIMITIVES,
+    CollectiveAudit,
+    _eqn_axes,
+    _operand_bytes,
+    audit_jaxpr,
+    trace_step,
+)
+
+#: comma-separated rule ids disabled process-wide (e.g. "R003,R005").
+ENV_DISABLE = "CHAINERMN_TPU_LINT_DISABLE"
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+_DISABLE_COMMENT_RE = re.compile(r"#\s*lint:\s*disable=([A-Za-z0-9_, \t]+)")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One structured lint finding.
+
+    ``eqn_path`` is the primitive path from the jaxpr root to the
+    offending eqn (e.g. ``"pjit/shard_map/cond"``) — stable across runs,
+    unlike eqn indices.  ``bytes`` is the per-device operand payload the
+    finding is about (0 when not applicable).
+    """
+
+    rule: str
+    severity: str
+    message: str
+    eqn_path: str = ""
+    axes: Tuple[str, ...] = ()
+    bytes: int = 0
+    fix_hint: str = ""
+
+    def summary(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "message": self.message,
+            "eqn_path": self.eqn_path,
+            "axes": list(self.axes),
+            "bytes": self.bytes,
+            "fix_hint": self.fix_hint,
+        }
+
+    def render(self) -> str:
+        loc = f" at {self.eqn_path}" if self.eqn_path else ""
+        ax = f" axes={','.join(self.axes)}" if self.axes else ""
+        hint = f"\n    fix: {self.fix_hint}" if self.fix_hint else ""
+        return (
+            f"{self.rule} [{self.severity}]{loc}{ax}: {self.message}{hint}"
+        )
+
+
+@dataclasses.dataclass
+class Rule:
+    """A registered lint rule.  ``check(ctx)`` returns findings;
+    ``requires`` names the context pieces it needs (``"jaxpr"``,
+    ``"audit"``, ``"args"``) — the engine skips the rule, rather than
+    erroring, when an input form (e.g. a bare ``CollectiveAudit``)
+    cannot satisfy them."""
+
+    id: str
+    name: str
+    summary: str
+    check: Callable[["LintContext"], List[Finding]]
+    requires: Tuple[str, ...] = ("jaxpr",)
+
+
+#: rule id -> Rule.  Populated by the ``register_rule`` decorator when
+#: ``chainermn_tpu.analysis.rules`` imports (the engine imports it
+#: lazily, so registration cannot be missed).
+RULES: Dict[str, Rule] = {}
+
+
+def register_rule(rule_id: str, name: str, summary: str,
+                  requires: Tuple[str, ...] = ("jaxpr",)):
+    def deco(check):
+        RULES[rule_id] = Rule(rule_id, name, summary, check, requires)
+        return check
+
+    return deco
+
+
+def _registry() -> Dict[str, Rule]:
+    if not RULES:
+        from chainermn_tpu.analysis import rules as _rules  # noqa: F401
+    return RULES
+
+
+def list_rules() -> List[Tuple[str, str, str]]:
+    """``[(id, name, one-line summary)]`` for every registered rule."""
+    reg = _registry()
+    return [(r.id, r.name, r.summary) for _, r in sorted(reg.items())]
+
+
+# ----------------------------------------------------------------------
+# jaxpr walking with stable eqn paths
+# ----------------------------------------------------------------------
+def _inner_jaxpr(val):
+    if hasattr(val, "eqns"):
+        return val
+    if hasattr(val, "jaxpr"):
+        return val.jaxpr
+    return None
+
+
+def iter_eqns_with_path(jaxpr, path: str = "") -> Iterator[Tuple[str, Any]]:
+    """Depth-first eqn walk like ``hlo_audit.iter_eqns``, yielding
+    ``(path, eqn)`` where path is the slash-joined primitive chain from
+    the root (tuple-valued params like ``branches`` get an index)."""
+    if hasattr(jaxpr, "jaxpr"):
+        jaxpr = jaxpr.jaxpr
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        here = f"{path}/{name}" if path else name
+        yield here, eqn
+        for val in eqn.params.values():
+            if isinstance(val, (tuple, list)):
+                for j, v in enumerate(val):
+                    inner = _inner_jaxpr(v)
+                    if inner is not None:
+                        yield from iter_eqns_with_path(inner, f"{here}[{j}]")
+            else:
+                inner = _inner_jaxpr(val)
+                if inner is not None:
+                    yield from iter_eqns_with_path(inner, here)
+
+
+class CollectiveEvent(NamedTuple):
+    """One collective occurrence, canonicalized for fingerprinting."""
+
+    path: str
+    name: str
+    axes: Tuple[str, ...]
+    dtype: str
+    shape: Tuple[int, ...]
+    bytes: int
+
+
+def collective_events(jaxpr) -> List[CollectiveEvent]:
+    """Every collective in trace order — the canonical sequence whose
+    cross-rank agreement R001 checks."""
+    events = []
+    for path, eqn in iter_eqns_with_path(jaxpr):
+        if eqn.primitive.name not in COLLECTIVE_PRIMITIVES:
+            continue
+        aval = next(
+            (v.aval for v in eqn.invars if hasattr(v.aval, "shape")), None
+        )
+        events.append(CollectiveEvent(
+            path=path,
+            name=eqn.primitive.name,
+            axes=tuple(str(a) for a in _eqn_axes(eqn)),
+            dtype=str(getattr(aval, "dtype", "?")),
+            shape=tuple(getattr(aval, "shape", ())),
+            bytes=_operand_bytes(eqn),
+        ))
+    return events
+
+
+def collective_fingerprint(jaxpr) -> str:
+    """Canonical digest of the collective sequence (primitive, axes,
+    dtype, shape, in trace order).  Two ranks whose step programs hash
+    differently WILL deadlock or corrupt at the first mismatched
+    dispatch — comparing this string over the communicator's object
+    plane is the pre-launch check."""
+    sig = [
+        [e.name, list(e.axes), e.dtype, list(e.shape)]
+        for e in collective_events(jaxpr)
+    ]
+    return hashlib.sha256(
+        json.dumps(sig, separators=(",", ":")).encode()
+    ).hexdigest()
+
+
+# ----------------------------------------------------------------------
+# Context and report
+# ----------------------------------------------------------------------
+@dataclasses.dataclass
+class LintContext:
+    """Everything a rule may look at.  Any piece may be ``None``/empty
+    depending on the entry point; a rule's ``requires`` declares what it
+    cannot do without."""
+
+    closed_jaxpr: Any = None
+    audit: Optional[CollectiveAudit] = None
+    comm: Any = None
+    donate_argnums: Optional[Tuple[int, ...]] = None
+    #: per-positional-arg lists of (shape, dtype-str) leaf signatures.
+    arg_leaf_avals: Optional[List[List[Tuple[tuple, str]]]] = None
+    n_kwarg_leaves: int = 0
+    batch_argnum: int = -1
+    dp_axes: Tuple[str, ...] = ()
+    n_leaves: Optional[int] = None
+    fn: Any = None
+    _events: Optional[List[CollectiveEvent]] = None
+
+    @property
+    def jaxpr(self):
+        j = self.closed_jaxpr
+        return j.jaxpr if hasattr(j, "jaxpr") else j
+
+    def events(self) -> List[CollectiveEvent]:
+        if self._events is None:
+            self._events = (
+                collective_events(self.jaxpr)
+                if self.closed_jaxpr is not None else []
+            )
+        return self._events
+
+    def get_audit(self) -> Optional[CollectiveAudit]:
+        if self.audit is None and self.closed_jaxpr is not None:
+            self.audit = audit_jaxpr(self.closed_jaxpr)
+        return self.audit
+
+    def has(self, req: str) -> bool:
+        if req == "jaxpr":
+            return self.closed_jaxpr is not None
+        if req == "audit":
+            return self.get_audit() is not None
+        if req == "args":
+            return self.arg_leaf_avals is not None
+        return False
+
+
+class LintError(AssertionError):
+    """Raised by :func:`assert_lint_clean`; carries the full report."""
+
+    def __init__(self, report: "LintReport"):
+        self.report = report
+        super().__init__(report.render())
+
+
+@dataclasses.dataclass
+class LintReport:
+    findings: List[Finding]
+    rules_run: Tuple[str, ...] = ()
+    rules_skipped: Tuple[str, ...] = ()
+    suppressed: int = 0
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == SEVERITY_ERROR]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def summary(self) -> dict:
+        return {
+            "ok": self.ok,
+            "findings": [f.summary() for f in self.findings],
+            "rules_run": list(self.rules_run),
+            "rules_skipped": list(self.rules_skipped),
+            "suppressed": self.suppressed,
+        }
+
+    def render(self) -> str:
+        if not self.findings:
+            return (
+                f"lint clean ({len(self.rules_run)} rules: "
+                f"{', '.join(self.rules_run)})"
+            )
+        lines = [f.render() for f in self.findings]
+        lines.append(
+            f"{len(self.findings)} finding(s), {len(self.errors)} error(s)"
+        )
+        return "\n".join(lines)
+
+
+# ----------------------------------------------------------------------
+# Suppression
+# ----------------------------------------------------------------------
+def _source_disables(fn) -> frozenset:
+    """Rule ids named in ``# lint: disable=R00x`` comments in ``fn``'s
+    source (the per-step allowlist; see docs/static_analysis.md)."""
+    if fn is None:
+        return frozenset()
+    try:
+        src = inspect.getsource(inspect.unwrap(fn))
+    except (TypeError, OSError):
+        return frozenset()
+    ids = set()
+    for m in _DISABLE_COMMENT_RE.finditer(src):
+        ids.update(t.strip() for t in m.group(1).split(",") if t.strip())
+    return frozenset(ids)
+
+
+def _env_disables() -> frozenset:
+    raw = os.environ.get(ENV_DISABLE, "")
+    return frozenset(t.strip() for t in raw.split(",") if t.strip())
+
+
+# ----------------------------------------------------------------------
+# Engine
+# ----------------------------------------------------------------------
+def _run_rules(ctx: LintContext, rules: Optional[Sequence[str]],
+               disable: Sequence[str]) -> LintReport:
+    reg = _registry()
+    selected = list(rules) if rules else sorted(reg)
+    unknown = [r for r in selected if r not in reg]
+    if unknown:
+        raise ValueError(
+            f"unknown lint rule(s) {unknown}; known: {sorted(reg)}"
+        )
+    disabled = set(disable) | _env_disables() | _source_disables(ctx.fn)
+
+    run, skipped, findings, suppressed = [], [], [], 0
+    for rid in selected:
+        if rid in disabled:
+            suppressed += 1
+            continue
+        rule = reg[rid]
+        if not all(ctx.has(req) for req in rule.requires):
+            skipped.append(rid)
+            continue
+        findings.extend(rule.check(ctx))
+        run.append(rid)
+    findings.sort(key=lambda f: (f.rule, f.eqn_path))
+    return LintReport(
+        findings=findings,
+        rules_run=tuple(run),
+        rules_skipped=tuple(skipped),
+        suppressed=suppressed,
+    )
+
+
+def _leaf_sig(leaf) -> Tuple[tuple, str]:
+    return (
+        tuple(getattr(leaf, "shape", ())),
+        str(getattr(leaf, "dtype", "?")),
+    )
+
+
+def _resolve_dp_axes(ctx: LintContext) -> None:
+    """Fill ``ctx.dp_axes`` when the caller did not pin them: the
+    communicator's axes when one is in hand, else the union of axes any
+    collective runs over, else the mesh axis names of the outermost
+    shard_map (the no-collectives-at-all case R002 exists to catch)."""
+    if ctx.dp_axes or ctx.closed_jaxpr is None:
+        return
+    if ctx.comm is not None:
+        ctx.dp_axes = tuple(str(a) for a in ctx.comm.axes)
+        return
+    axes = sorted({a for e in ctx.events() for a in e.axes})
+    if axes:
+        ctx.dp_axes = tuple(axes)
+        return
+    for _, eqn in iter_eqns_with_path(ctx.jaxpr):
+        if eqn.primitive.name == "shard_map":
+            names = getattr(eqn.params.get("mesh"), "axis_names", None)
+            if names:
+                ctx.dp_axes = tuple(str(a) for a in names)
+                return
+
+
+def analyze_fn(fn, *args, comm=None, rules: Optional[Sequence[str]] = None,
+               disable: Sequence[str] = (), batch_argnum: int = -1,
+               dp_axes: Optional[Sequence[str]] = None,
+               **kwargs) -> LintReport:
+    """Trace ``fn(*args, **kwargs)`` abstractly and lint the program.
+
+    ``fn`` may be plain or already ``jax.jit``-wrapped (the shared
+    :func:`trace_step` entry point handles both without double-tracing);
+    args may be arrays or ``jax.ShapeDtypeStruct``s.  ``comm`` enables
+    the cross-rank fingerprint check (R001) and communicator-aware
+    intent checks (R003's ``allreduce_grad_dtype``).  ``batch_argnum``
+    names the positional arg carrying the data-parallel batch (default:
+    the last one, the ``make_train_step`` convention) for the R002
+    taint sources; ``dp_axes`` pins the data-parallel mesh axes when
+    the defaults (communicator axes, then collective/shard_map axes)
+    would guess wrong.
+    """
+    import jax
+
+    traced = trace_step(fn, *args, **kwargs)
+    ctx = LintContext(
+        closed_jaxpr=traced.closed_jaxpr,
+        comm=comm,
+        donate_argnums=traced.donate_argnums,
+        arg_leaf_avals=[
+            [_leaf_sig(l) for l in jax.tree.leaves(a)] for a in args
+        ],
+        n_kwarg_leaves=len(jax.tree.leaves(kwargs)),
+        batch_argnum=batch_argnum,
+        dp_axes=tuple(dp_axes) if dp_axes else (),
+        fn=fn,
+    )
+    _resolve_dp_axes(ctx)
+    return _run_rules(ctx, rules, disable)
+
+
+def analyze_jaxpr(jaxpr_or_audit, comm=None,
+                  rules: Optional[Sequence[str]] = None,
+                  disable: Sequence[str] = (),
+                  dp_axes: Optional[Sequence[str]] = None,
+                  n_leaves: Optional[int] = None) -> LintReport:
+    """Lint an already-traced (Closed)Jaxpr, or a bare
+    :class:`CollectiveAudit` (audit-only rules such as R004 then run;
+    jaxpr rules are reported in ``rules_skipped``).  ``n_leaves`` feeds
+    R004's leaf-count comparison when no arg structure is in hand."""
+    if isinstance(jaxpr_or_audit, CollectiveAudit):
+        ctx = LintContext(audit=jaxpr_or_audit, comm=comm,
+                          n_leaves=n_leaves)
+    else:
+        ctx = LintContext(closed_jaxpr=jaxpr_or_audit, comm=comm,
+                          dp_axes=tuple(dp_axes) if dp_axes else (),
+                          n_leaves=n_leaves)
+        _resolve_dp_axes(ctx)
+    return _run_rules(ctx, rules, disable)
+
+
+def assert_lint_clean(fn, *args, comm=None,
+                      rules: Optional[Sequence[str]] = None,
+                      disable: Sequence[str] = (), **kwargs) -> LintReport:
+    """Lint and raise :class:`LintError` on any error-severity finding.
+    Returns the (clean) report otherwise — the one-liner for tests and
+    pre-launch gates."""
+    report = analyze_fn(
+        fn, *args, comm=comm, rules=rules, disable=disable, **kwargs
+    )
+    if not report.ok:
+        raise LintError(report)
+    return report

@@ -1,0 +1,187 @@
+"""Taint dataflow over jaxprs: which values still carry *un-reduced*
+per-device content.
+
+The R002 question — "does a gradient computed from this device's batch
+shard reach the optimizer update without a reduction over the
+data-parallel axes?" — is a forward dataflow problem.  Each variable
+carries a taint: the set of data-parallel axis names whose reduction it
+still owes.  Batch inputs start tainted with every data-parallel axis;
+``psum``/``psum_scatter`` eqns clear the axes they reduce over from
+their operands' joint taint; every other eqn propagates the union of
+its inputs' taints (sound over-approximation: any output *may* depend
+on any input).  Control/structural primitives recurse into their inner
+jaxprs so the analysis sees through ``pjit``, ``shard_map``, ``scan``
+(fixpoint over the carry), ``while`` and ``cond``; an inner jaxpr whose
+arity does not match falls back to the conservative joint-taint rule
+rather than guessing a mapping.
+
+Taints only grow through union and the axis-name universe is finite,
+so every fixpoint below terminates; ``max_iter`` is a belt against a
+pathological jaxpr, not a correctness knob.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, FrozenSet, List
+
+from chainermn_tpu.observability.hlo_audit import (
+    REDUCTION_PRIMITIVES,
+    _eqn_axes,
+)
+
+EMPTY: FrozenSet[str] = frozenset()
+
+#: param keys under which jax stores a single inner jaxpr with invars
+#: matching the eqn's 1:1 (pjit, shard_map, closed_call, custom_jvp/vjp,
+#: remat) — probed against arity before use, never trusted blindly.
+_INNER_JAXPR_KEYS = ("jaxpr", "call_jaxpr", "fun_jaxpr")
+
+
+def _as_jaxpr(v):
+    # ClosedJaxpr forwards .eqns, so probe for the wrapper FIRST — the
+    # callers below need the raw Jaxpr's .invars.
+    if hasattr(v, "jaxpr"):
+        return v.jaxpr
+    if hasattr(v, "eqns"):
+        return v
+    return None
+
+
+def _eqn_reduced_axes(eqn) -> FrozenSet[str]:
+    return frozenset(str(a) for a in _eqn_axes(eqn))
+
+
+def propagate(jaxpr, in_taints: List[FrozenSet[str]],
+              max_iter: int = 8) -> List[FrozenSet[str]]:
+    """Map per-invar taints to per-outvar taints for one (Closed)Jaxpr."""
+    if hasattr(jaxpr, "jaxpr"):
+        jaxpr = jaxpr.jaxpr
+    if len(jaxpr.invars) != len(in_taints):
+        raise ValueError(
+            f"in_taints length {len(in_taints)} != jaxpr invars "
+            f"{len(jaxpr.invars)}"
+        )
+    env: Dict[Any, FrozenSet[str]] = {}
+
+    def read(v) -> FrozenSet[str]:
+        if hasattr(v, "val"):  # Literal
+            return EMPTY
+        return env.get(v, EMPTY)
+
+    def write(v, t: FrozenSet[str]) -> None:
+        if hasattr(v, "val"):
+            return
+        env[v] = env.get(v, EMPTY) | t
+
+    for v, t in zip(jaxpr.invars, in_taints):
+        write(v, t)
+    # constvars are trace-time constants: closure-captured values, never
+    # this call's batch — untainted by construction (default read).
+
+    for eqn in jaxpr.eqns:
+        _process(eqn, read, write, max_iter)
+    return [read(v) for v in jaxpr.outvars]
+
+
+def _union(ts) -> FrozenSet[str]:
+    out = EMPTY
+    for t in ts:
+        out = out | t
+    return out
+
+
+def _process(eqn, read, write, max_iter: int) -> None:
+    name = eqn.primitive.name
+    ins = [read(v) for v in eqn.invars]
+    joint = _union(ins)
+
+    if name in REDUCTION_PRIMITIVES:
+        cleared = joint - _eqn_reduced_axes(eqn)
+        for v in eqn.outvars:
+            write(v, cleared)
+        return
+
+    if name == "cond":
+        # invars[0] is the branch index; each branch's invars match the
+        # remaining operands.  Outputs take the union over branches plus
+        # the predicate's taint (a rank-dependent predicate makes every
+        # output rank-dependent, R001's territory — but taint-wise it
+        # still flows).
+        branches = eqn.params.get("branches", ())
+        pred, operand = (ins[0], ins[1:]) if ins else (EMPTY, [])
+        outs = None
+        for br in branches:
+            bj = _as_jaxpr(br)
+            if bj is None or len(bj.invars) != len(operand):
+                outs = None
+                break
+            res = propagate(br, operand, max_iter)
+            outs = res if outs is None else [
+                a | b for a, b in zip(outs, res)
+            ]
+        if outs is not None and len(outs) == len(eqn.outvars):
+            for v, t in zip(eqn.outvars, outs):
+                write(v, t | pred)
+            return
+        for v in eqn.outvars:
+            write(v, joint)
+        return
+
+    if name == "scan":
+        inner = eqn.params.get("jaxpr")
+        nc = eqn.params.get("num_consts", 0)
+        nk = eqn.params.get("num_carry", 0)
+        ij = _as_jaxpr(inner)
+        if ij is not None and len(ij.invars) == len(eqn.invars):
+            consts, carry, xs = ins[:nc], ins[nc:nc + nk], ins[nc + nk:]
+            res = None
+            for _ in range(max_iter):
+                res = propagate(inner, consts + carry + xs, max_iter)
+                new_carry = [a | b for a, b in zip(carry, res[:nk])]
+                if new_carry == carry:
+                    break
+                carry = new_carry
+            outs = carry + list(res[nk:])
+            if len(outs) == len(eqn.outvars):
+                for v, t in zip(eqn.outvars, outs):
+                    write(v, t)
+                return
+        for v in eqn.outvars:
+            write(v, joint)
+        return
+
+    if name == "while":
+        body = eqn.params.get("body_jaxpr")
+        cn = eqn.params.get("cond_nconsts", 0)
+        bn = eqn.params.get("body_nconsts", 0)
+        bj = _as_jaxpr(body)
+        carry = ins[cn + bn:]
+        if bj is not None and len(bj.invars) == bn + len(carry):
+            consts = ins[cn:cn + bn]
+            for _ in range(max_iter):
+                res = propagate(body, consts + carry, max_iter)
+                new_carry = [a | b for a, b in zip(carry, res)]
+                if new_carry == carry:
+                    break
+                carry = new_carry
+            if len(carry) == len(eqn.outvars):
+                for v, t in zip(eqn.outvars, carry):
+                    write(v, t)
+                return
+        for v in eqn.outvars:
+            write(v, joint)
+        return
+
+    for key in _INNER_JAXPR_KEYS:
+        inner = eqn.params.get(key)
+        ij = _as_jaxpr(inner) if inner is not None else None
+        if ij is not None and len(ij.invars) == len(eqn.invars):
+            res = propagate(inner, ins, max_iter)
+            if len(res) == len(eqn.outvars):
+                for v, t in zip(eqn.outvars, res):
+                    write(v, t)
+                return
+            break
+
+    for v in eqn.outvars:
+        write(v, joint)

@@ -1,0 +1,184 @@
+"""Seeded-violation fixtures: one deliberately broken program per rule,
+plus the clean train step none of them may flag.
+
+These are the linter's own regression corpus — ``python -m
+chainermn_tpu.tools.lint --fixtures`` lints them (and must exit
+nonzero), ``tests/test_analysis.py`` asserts each one is flagged with
+its expected rule id.  Every builder adapts to the available device
+count, so the corpus runs on the 8-device virtual CPU mesh and on a
+single real chip alike.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from chainermn_tpu.communicators import build_mesh, create_communicator
+from chainermn_tpu.optimizers import create_multi_node_optimizer
+
+#: the clean-gate communicator set (mirrors the golden-census test).
+CLEAN_COMMUNICATORS = (
+    "naive", "flat", "xla_ici", "hierarchical", "two_dimensional",
+)
+
+
+def _mesh():
+    """A 2-D (inter, intra) mesh over every available device — (2, n/2)
+    when the count allows, so both collective legs are exercised."""
+    devs = jax.devices()
+    n = len(devs)
+    inter = 2 if n % 2 == 0 and n >= 2 else 1
+    return build_mesh(inter_size=inter, intra_size=n // inter, devices=devs)
+
+
+def _sds(shape, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def _leafy_params(n_leaves: int, shape=(32, 32)):
+    return {f"w{i:02d}": jnp.ones(shape, jnp.float32)
+            for i in range(n_leaves)}
+
+
+def _leafy_loss(params, batch):
+    scale = jnp.mean(batch.astype(jnp.float32) ** 2)
+    return scale * sum(jnp.vdot(w, w) for w in jax.tree.leaves(params))
+
+
+def fixture_r001() -> dict:
+    """Collective-order divergence: a psum behind a rank-dependent
+    branch — rank 0 dispatches it, everyone else never does."""
+    comm = create_communicator("naive", mesh=_mesh())
+    n = comm.device_size
+
+    def diverging(x):
+        def body(v):
+            return lax.cond(
+                comm.axis_index() == 0,
+                lambda u: lax.psum(u, comm.axes),
+                lambda u: u,
+                v,
+            )
+        return comm.shard_map(
+            body, in_specs=(comm._world_spec,), out_specs=comm._world_spec
+        )(x)
+
+    return dict(
+        target="r001", expect="R001", fn=diverging,
+        args=(_sds((n, 16)),), kwargs={}, comm=comm,
+    )
+
+
+def fixture_r002() -> dict:
+    """Unreduced gradient: a hand-rolled train step that applies each
+    device's LOCAL gradients straight to the params — no psum, no
+    allreduce_grad — so the replicas silently diverge."""
+    comm = create_communicator("naive", mesh=_mesh())
+    n = comm.device_size
+
+    def loss_fn(params, batch):
+        return jnp.mean((batch @ params["w"] + params["b"]) ** 2)
+
+    def local_sgd_step(params, batch):
+        def body(params, batch):
+            grads = jax.grad(loss_fn)(params, batch)
+            return jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
+        return comm.shard_map(
+            body,
+            in_specs=(P(), P(comm.world_axes)),
+            out_specs=P(),
+        )(params, batch)
+
+    params = {"w": _sds((16, 4)), "b": _sds((4,))}
+    return dict(
+        target="r002", expect="R002", fn=local_sgd_step,
+        args=(params, _sds((n * 2, 16))), kwargs={}, comm=comm,
+    )
+
+
+def fixture_r003() -> dict:
+    """Narrow-dtype reduction: bf16 gradients through allreduce_grad
+    with NO explicit allreduce_grad_dtype — the psum accumulates in
+    bf16."""
+    comm = create_communicator("naive", mesh=_mesh())
+    n = comm.device_size
+
+    def reduce_bf16(tree):
+        def body(t):
+            sq = jax.tree.map(lambda x: jnp.squeeze(x, 0), t)
+            out = comm.allreduce_grad(sq)
+            return jax.tree.map(lambda x: x[None], out)
+        spec = jax.tree.map(lambda _: comm._world_spec, tree)
+        return comm.shard_map(body, in_specs=(spec,), out_specs=spec)(tree)
+
+    tree = {
+        "a": _sds((n, 256), jnp.bfloat16),
+        "b": _sds((n, 64, 8), jnp.bfloat16),
+    }
+    return dict(
+        target="r003", expect="R003", fn=reduce_bf16,
+        args=(tree,), kwargs={}, comm=comm,
+    )
+
+
+def fixture_r004() -> dict:
+    """Bucketing regression: a default train step over a 16-leaf tree
+    with bucketing disabled (bucket_bytes=0) — one psum per leaf."""
+    comm = create_communicator("naive", mesh=_mesh(), bucket_bytes=0)
+    opt = create_multi_node_optimizer(optax.sgd(0.1), comm)
+    params = _leafy_params(16)
+    state = opt.init(params)
+    step = opt.make_train_step(_leafy_loss)
+    batch = jnp.ones((comm.device_size * 2, 8), jnp.float32)
+    return dict(
+        target="r004", expect="R004", fn=step,
+        args=(params, state, batch), kwargs={}, comm=comm,
+    )
+
+
+def fixture_r005() -> dict:
+    """Donation audit: the same (bucketed, clean-wire) train step built
+    with donate=False — params and optimizer state double-buffer in
+    device memory for nothing."""
+    comm = create_communicator("naive", mesh=_mesh())
+    opt = create_multi_node_optimizer(optax.sgd(0.1), comm)
+    params = _leafy_params(16)
+    state = opt.init(params)
+    step = opt.make_train_step(_leafy_loss, donate=False)
+    batch = jnp.ones((comm.device_size * 2, 8), jnp.float32)
+    return dict(
+        target="r005", expect="R005", fn=step,
+        args=(params, state, batch), kwargs={}, comm=comm,
+    )
+
+
+FIXTURES: Dict[str, Callable[[], dict]] = {
+    "r001": fixture_r001,
+    "r002": fixture_r002,
+    "r003": fixture_r003,
+    "r004": fixture_r004,
+    "r005": fixture_r005,
+}
+
+
+def clean_train_step(communicator: str = "xla_ici",
+                     n_leaves: int = 8) -> dict:
+    """The program the whole package stands behind: a default bucketed
+    ``make_train_step`` (donation on, fp32 grads).  Must lint clean on
+    every rule for every communicator."""
+    comm = create_communicator(communicator, mesh=_mesh())
+    opt = create_multi_node_optimizer(optax.sgd(0.1), comm)
+    params = _leafy_params(n_leaves, shape=(16, 16))
+    state = opt.init(params)
+    step = opt.make_train_step(_leafy_loss)
+    batch = jnp.ones((comm.device_size * 2, 8), jnp.float32)
+    return dict(
+        target=f"clean:{communicator}", expect=None, fn=step,
+        args=(params, state, batch), kwargs={}, comm=comm,
+    )

@@ -40,8 +40,6 @@ it is always 0 and ``local_devices`` is the real answer.
 
 from __future__ import annotations
 
-import functools
-import math
 import os
 import pickle
 import warnings
@@ -51,7 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from . import kvtransport, mesh_utils, packing
 

@@ -34,7 +34,6 @@ import os
 import queue as _queue
 import traceback
 from multiprocessing import shared_memory
-from typing import Optional, Sequence
 
 import numpy as np
 

@@ -21,7 +21,7 @@ the reference: seeded global permutation, contiguous ±1-equal chunks,
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence
+from typing import Optional
 
 import numpy as np
 

@@ -23,7 +23,6 @@ from __future__ import annotations
 from typing import Callable, Dict
 
 import jax
-import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 

@@ -36,11 +36,10 @@ transfer's endpoints are both named at trace time.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Sequence
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 from chainermn_tpu.communicators.base import CommunicatorBase
 

@@ -10,7 +10,7 @@ branches) rather than any line-level translation.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Sequence
+from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp

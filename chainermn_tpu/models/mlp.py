@@ -8,7 +8,6 @@ any parameter container works, flax being the idiomatic choice on TPU.
 from __future__ import annotations
 
 import flax.linen as nn
-import jax.numpy as jnp
 
 
 class MLP(nn.Module):

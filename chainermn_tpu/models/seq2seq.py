@@ -12,10 +12,8 @@ clean encoder/decoder split so the pair drops into ``MultiNodeChainList``
 
 from __future__ import annotations
 
-from typing import Any
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 
 PAD, BOS, EOS = 0, 1, 2

@@ -38,10 +38,12 @@ from chainermn_tpu.observability.step_log import (  # noqa: F401
 )
 from chainermn_tpu.observability.hlo_audit import (  # noqa: F401
     CollectiveAudit,
+    TracedStep,
     audit_allreduce,
     audit_allreduce_tree,
     audit_fn,
     audit_jaxpr,
+    trace_step,
 )
 from chainermn_tpu.observability.spans import (  # noqa: F401
     named_scope,

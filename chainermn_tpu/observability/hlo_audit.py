@@ -20,7 +20,7 @@ source of truth for the bytes-per-leg metric); examples call
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -144,13 +144,53 @@ def audit_jaxpr(jaxpr) -> CollectiveAudit:
     return CollectiveAudit(counts, per_axis, per_prim, op_bytes)
 
 
-def audit_fn(fn, *args, **kwargs) -> CollectiveAudit:
-    """Trace ``fn(*args, **kwargs)`` (jitted or plain — ``make_jaxpr``
-    traces through ``jit``) and audit the resulting program.  Args may
-    be real arrays or ``jax.ShapeDtypeStruct``s; nothing executes."""
+class TracedStep(NamedTuple):
+    """One abstract trace of a step function — the shared entry point the
+    audit AND the collective linter (:mod:`chainermn_tpu.analysis`) build
+    on, so a step is traced exactly once however it is wrapped.
+
+    ``donate_argnums`` carries the jit wrapper's donation declaration when
+    the AOT ``trace`` path supplied it; ``None`` means "unknown — look for
+    ``pjit`` eqn ``donated_invars`` inside the jaxpr instead".
+    """
+
+    closed_jaxpr: Any
+    donate_argnums: Optional[Tuple[int, ...]]
+
+
+def trace_step(fn, *args, **kwargs) -> TracedStep:
+    """Trace ``fn(*args, **kwargs)`` without executing it.
+
+    Accepts plain callables AND already-``jax.jit``-wrapped ones: a jitted
+    callable is traced through its own AOT ``.trace`` surface (one trace,
+    reusing jit's cached machinery — no re-wrap double-trace), which also
+    exposes its ``donate_argnums``; everything else goes through
+    ``jax.make_jaxpr``, kwargs included.  Args may be real arrays or
+    ``jax.ShapeDtypeStruct``s."""
     import jax
 
-    return audit_jaxpr(jax.make_jaxpr(fn)(*args, **kwargs))
+    tracer = getattr(fn, "trace", None)
+    if callable(tracer):
+        try:
+            tr = tracer(*args, **kwargs)
+            closed = getattr(tr, "jaxpr", None)
+            if closed is not None:
+                donate = getattr(tr, "donate_argnums", None)
+                return TracedStep(
+                    closed, tuple(donate) if donate is not None else None
+                )
+        except Exception:
+            pass  # not jit's AOT surface — fall through to make_jaxpr
+    return TracedStep(jax.make_jaxpr(fn)(*args, **kwargs), None)
+
+
+def audit_fn(fn, *args, **kwargs) -> CollectiveAudit:
+    """Trace ``fn(*args, **kwargs)`` (jitted or plain) and audit the
+    resulting program.  Args may be real arrays or
+    ``jax.ShapeDtypeStruct``s; nothing executes.  Delegates the tracing
+    to :func:`trace_step` — the entry point shared with the collective
+    linter — so jitted callables and kwargs take the single-trace path."""
+    return audit_jaxpr(trace_step(fn, *args, **kwargs).closed_jaxpr)
 
 
 def _allreduce_jaxpr(comm, nbytes: int, dtype):

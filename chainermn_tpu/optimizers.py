@@ -33,8 +33,8 @@ reference API.
 
 from __future__ import annotations
 
-import dataclasses
 import functools
+import os
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -87,6 +87,71 @@ def _instrument_step(step_fn):
         if hasattr(step_fn, attr):
             setattr(instrumented, attr, getattr(step_fn, attr))
     return instrumented
+
+
+def _run_first_call_lint(step_fn, comm, mode, args, kwargs):
+    """One lint pass over the step being compiled for the first time.
+    Lint infrastructure failures must never take down training, so
+    everything short of a strict-mode violation is a warning."""
+    import warnings
+
+    try:
+        from chainermn_tpu.analysis import analyze_fn
+
+        report = analyze_fn(step_fn, *args, comm=comm, **kwargs)
+    except Exception as e:  # tracing oddity, not a user bug
+        warnings.warn(f"CHAINERMN_TPU_LINT: lint pass failed: {e!r}")
+        return
+    try:
+        from chainermn_tpu.observability import reporter as _rep
+        from chainermn_tpu.observability import step_log as _sl
+
+        rep = _rep.get_reporter()
+        if rep is not None:
+            rep.count("lint/findings", len(report.findings))
+            rep.count("lint/errors", len(report.errors))
+        rec = _sl.current_recorder()
+        if rec is not None:
+            rec.record(
+                "lint",
+                rules_run=list(report.rules_run),
+                findings=[f.summary() for f in report.findings],
+            )
+    except Exception:
+        pass
+    if report.errors:
+        if mode == "strict":
+            from chainermn_tpu.analysis import LintError
+
+            raise LintError(report)
+        warnings.warn(
+            "CHAINERMN_TPU_LINT found problems in the train step:\n"
+            + report.render()
+        )
+
+
+def _lint_hook(step_fn, comm):
+    """Opt-in static lint at the step's first call (the call that pays
+    for compilation anyway): ``CHAINERMN_TPU_LINT=1`` warns and reports
+    through the Reporter/step log, ``=strict`` raises ``LintError``.
+    Unset, the step function passes through untouched — and after the
+    first call the cost is one list check."""
+    mode = os.environ.get("CHAINERMN_TPU_LINT", "").strip().lower()
+    if mode in ("", "0", "off", "false"):
+        return step_fn
+    done = []
+
+    @functools.wraps(step_fn)
+    def linted(*args, **kwargs):
+        if not done:
+            done.append(True)
+            _run_first_call_lint(step_fn, comm, mode, args, kwargs)
+        return step_fn(*args, **kwargs)
+
+    for attr in ("lower", "eval_shape", "trace"):
+        if hasattr(step_fn, attr):
+            setattr(linted, attr, getattr(step_fn, attr))
+    return linted
 
 
 def flat_shard_state_spec(optimizer, shard_size: int, world):
@@ -508,6 +573,11 @@ class MultiNodeOptimizer:
             comm_buf=P(world) if self.double_buffering else (),
         )
 
+    def _finalize_step(self, step_fn):
+        """Every built train step exits through here: the opt-in lint
+        hook (innermost, so it traces the bare step) then telemetry."""
+        return _instrument_step(_lint_hook(step_fn, self.communicator))
+
     def make_train_step(
         self,
         loss_fn: Callable,
@@ -549,11 +619,11 @@ class MultiNodeOptimizer:
         if n_accum < 1:
             raise ValueError(f"n_accum must be >= 1, got {n_accum}")
         if self.zero_stage in (1, 2):
-            return _instrument_step(self._make_zero_train_step(
+            return self._finalize_step(self._make_zero_train_step(
                 loss_fn, batch_spec, donate, has_aux, rng, n_accum, loss_scale
             ))
         if self.zero_stage == 3:
-            return _instrument_step(self._make_zero3_train_step(
+            return self._finalize_step(self._make_zero3_train_step(
                 loss_fn, batch_spec, donate, has_aux, rng, n_accum, loss_scale
             ))
         one = self._make_micro_grad_fn(loss_fn, has_aux, loss_scale)
@@ -585,7 +655,7 @@ class MultiNodeOptimizer:
             _check_batch_divisibility(batch, n_dev, n_accum)
             return jitted(params, state, batch)
 
-        return _instrument_step(step)
+        return self._finalize_step(step)
 
     def _scatter_grads(self, grads, shard_size, n, world):
         """Pack a full local gradient tree and reduce-scatter it to this
@@ -818,7 +888,7 @@ class MultiNodeOptimizer:
             return loss, new_model_state, grads
 
         if self.zero_stage > 0:
-            return _instrument_step(self._make_zero_with_state_step(
+            return self._finalize_step(self._make_zero_with_state_step(
                 grads_and_state, batch_spec, donate
             ))
 
@@ -835,7 +905,9 @@ class MultiNodeOptimizer:
             out_specs=(P(),) * 4,
         )
         donate_argnums = (0, 1, 2) if donate else ()
-        return _instrument_step(jax.jit(mapped, donate_argnums=donate_argnums))
+        return self._finalize_step(
+            jax.jit(mapped, donate_argnums=donate_argnums)
+        )
 
     def _make_zero_with_state_step(self, grads_and_state, batch_spec, donate):
         """ZeRO tails for the with-model-state step.  Stages 1/2 are

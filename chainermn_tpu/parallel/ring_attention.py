@@ -22,7 +22,6 @@ trade FLOPs for HBM.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

@@ -1,0 +1,276 @@
+"""Collective-correctness lint CLI.
+
+Runs the static linter (:mod:`chainermn_tpu.analysis`) from the shell —
+the pre-launch gate a CI job or an operator runs before committing a
+multi-host TPU slice to a training job.
+
+Usage::
+
+    # clean gate: lint the default bucketed train step on every
+    # communicator backend (exit 0 when clean):
+    python -m chainermn_tpu.tools.lint
+
+    # the seeded-violation corpus — every rule must fire (exit 1):
+    python -m chainermn_tpu.tools.lint --fixtures
+
+    # one rule subset, machine-readable:
+    python -m chainermn_tpu.tools.lint --rules R001,R004 --format json
+
+    # lint YOUR step: point at a zero-arg builder returning
+    # dict(fn=..., args=..., kwargs=..., comm=...):
+    python -m chainermn_tpu.tools.lint --entry mypkg.train:lint_target
+
+    # repo self-check: ruff (or the builtin AST fallback when ruff is
+    # not installed) over the package + examples, plus the clean gate:
+    python -m chainermn_tpu.tools.lint --self
+
+Exit status is nonzero iff any error-severity finding (or self-check
+problem) survives the ``--rules``/``--disable`` filters.  Rule catalog
+and suppression: docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+from typing import List, Optional, Tuple
+
+_REPO_SOURCE_DIRS = ("chainermn_tpu", "examples")
+_NOQA_RE = re.compile(r"#\s*noqa\b")
+
+
+def _split_csv(raw: Optional[str]) -> Optional[List[str]]:
+    if raw is None:
+        return None
+    return [t.strip() for t in raw.split(",") if t.strip()]
+
+
+def _lint_one(target: dict, rules, disable) -> dict:
+    from chainermn_tpu.analysis import analyze_fn
+
+    report = analyze_fn(
+        target["fn"], *target.get("args", ()),
+        comm=target.get("comm"), rules=rules, disable=disable or (),
+        **target.get("kwargs", {}),
+    )
+    return {
+        "target": target.get("target", getattr(
+            target["fn"], "__name__", "<fn>")),
+        "expect": target.get("expect"),
+        **report.summary(),
+    }
+
+
+def _clean_gate_targets(communicators) -> list:
+    from chainermn_tpu.analysis.fixtures import clean_train_step
+
+    return [clean_train_step(name) for name in communicators]
+
+
+def _fixture_targets(names) -> list:
+    from chainermn_tpu.analysis.fixtures import FIXTURES
+
+    picks = names or sorted(FIXTURES)
+    unknown = [n for n in picks if n not in FIXTURES]
+    if unknown:
+        raise SystemExit(
+            f"unknown fixture(s) {unknown}; known: {sorted(FIXTURES)}"
+        )
+    return [FIXTURES[n]() for n in picks]
+
+
+def _entry_target(spec: str) -> dict:
+    """``module.path:builder`` — import and call the zero-arg builder;
+    it returns ``dict(fn=..., args=..., kwargs=..., comm=...)`` (or a
+    bare callable, linted with no args)."""
+    import importlib
+
+    mod_name, _, attr = spec.partition(":")
+    if not attr:
+        raise SystemExit(f"--entry wants MODULE:BUILDER, got {spec!r}")
+    built = getattr(importlib.import_module(mod_name), attr)()
+    if callable(built):
+        built = dict(fn=built, args=(), kwargs={})
+    built.setdefault("target", spec)
+    return built
+
+
+# ----------------------------------------------------------------------
+# --self: source-level checks (ruff when installed, AST fallback)
+# ----------------------------------------------------------------------
+def _iter_py_files(roots):
+    for root in roots:
+        if os.path.isfile(root):
+            yield root
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames
+                           if not d.startswith((".", "__pycache__"))]
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    yield os.path.join(dirpath, f)
+
+
+def _builtin_source_check(roots) -> List[str]:
+    """No-dependency fallback when ruff is absent from the environment:
+    syntax errors plus module-level imports never referenced (skipping
+    ``__init__.py`` re-export facades and ``# noqa`` lines)."""
+    problems: List[str] = []
+    for path in _iter_py_files(roots):
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            problems.append(f"{path}:{e.lineno}: syntax error: {e.msg}")
+            continue
+        if os.path.basename(path) == "__init__.py":
+            continue
+        lines = src.splitlines()
+        imported: List[Tuple[str, int]] = []
+        for node in tree.body:
+            names = []
+            if isinstance(node, ast.Import):
+                names = [(a.asname or a.name.split(".")[0], node.lineno)
+                         for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":  # directive, not a binding
+                    continue
+                names = [(a.asname or a.name, node.lineno)
+                         for a in node.names if a.name != "*"]
+            for name, lineno in names:
+                line = lines[lineno - 1] if lineno <= len(lines) else ""
+                if not _NOQA_RE.search(line) and not name.startswith("_"):
+                    imported.append((name, lineno))
+        if not imported:
+            continue
+        used = {n.id for n in ast.walk(tree) if isinstance(n, ast.Name)}
+        for node in ast.walk(tree):  # __all__-style string re-exports
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                used.add(node.value)
+        for name, lineno in imported:
+            if name not in used:
+                problems.append(
+                    f"{path}:{lineno}: unused import {name!r}"
+                )
+    return problems
+
+
+def _self_check(repo_root: str) -> Tuple[List[str], str]:
+    roots = [os.path.join(repo_root, d) for d in _REPO_SOURCE_DIRS]
+    roots = [r for r in roots if os.path.exists(r)]
+    ruff = shutil.which("ruff")
+    if ruff:
+        proc = subprocess.run(
+            [ruff, "check", *roots], capture_output=True, text=True
+        )
+        out = (proc.stdout + proc.stderr).strip()
+        problems = out.splitlines() if proc.returncode else []
+        return problems, "ruff"
+    return _builtin_source_check(roots), "builtin-ast"
+
+
+# ----------------------------------------------------------------------
+def _render_text(results: List[dict]) -> str:
+    lines = []
+    for r in results:
+        status = "clean" if r["ok"] else "FINDINGS"
+        lines.append(f"{r['target']}: {status}")
+        for f in r["findings"]:
+            loc = f" at {f['eqn_path']}" if f["eqn_path"] else ""
+            lines.append(
+                f"  {f['rule']} [{f['severity']}]{loc}: {f['message']}"
+            )
+            if f["fix_hint"]:
+                lines.append(f"    fix: {f['fix_hint']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m chainermn_tpu.tools.lint",
+        description="Static collective-correctness linter "
+                    "(docs/static_analysis.md).",
+    )
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule allowlist (e.g. R001,R004)")
+    ap.add_argument("--disable", default=None,
+                    help="comma-separated rule ids to suppress")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--fixtures", nargs="*", default=None, metavar="NAME",
+                    help="lint the seeded-violation corpus (all fixtures "
+                         "when no names given); exits nonzero — every "
+                         "fixture is a real violation")
+    ap.add_argument("--communicators", default=None,
+                    help="clean-gate backend list (default: all five)")
+    ap.add_argument("--entry", action="append", default=[],
+                    metavar="MODULE:BUILDER",
+                    help="lint a user step from a zero-arg builder "
+                         "returning dict(fn=, args=, kwargs=, comm=)")
+    ap.add_argument("--self", dest="self_check", action="store_true",
+                    help="source checks (ruff or builtin fallback) over "
+                         "the package + examples, plus the clean gate")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        from chainermn_tpu.analysis import list_rules
+
+        rows = [{"id": i, "name": n, "summary": s}
+                for i, n, s in list_rules()]
+        if args.format == "json":
+            print(json.dumps({"rules": rows}, indent=2))
+        else:
+            for r in rows:
+                print(f"{r['id']}  {r['name']}: {r['summary']}")
+        return 0
+
+    rules = _split_csv(args.rules)
+    disable = _split_csv(args.disable)
+
+    self_problems: List[str] = []
+    self_engine = None
+    targets: list = []
+    if args.self_check:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        self_problems, self_engine = _self_check(repo_root)
+    if args.fixtures is not None:
+        targets.extend(_fixture_targets(args.fixtures))
+    for spec in args.entry:
+        targets.append(_entry_target(spec))
+    if not targets and args.fixtures is None and not args.entry:
+        from chainermn_tpu.analysis.fixtures import CLEAN_COMMUNICATORS
+
+        comms = _split_csv(args.communicators) or list(CLEAN_COMMUNICATORS)
+        targets.extend(_clean_gate_targets(comms))
+
+    results = [_lint_one(t, rules, disable) for t in targets]
+    ok = all(r["ok"] for r in results) and not self_problems
+
+    if args.format == "json":
+        out = {"ok": ok, "targets": results}
+        if self_engine is not None:
+            out["self"] = {"engine": self_engine,
+                           "problems": self_problems}
+        print(json.dumps(out, indent=2, sort_keys=True))
+    else:
+        if self_engine is not None:
+            head = (f"self-check ({self_engine}): "
+                    f"{len(self_problems)} problem(s)")
+            print(head)
+            for p in self_problems:
+                print(f"  {p}")
+        if results:
+            print(_render_text(results))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,7 +27,7 @@ as a hang or garbage).  This debug mode makes the contract checkable:
 from __future__ import annotations
 
 import json
-from typing import Any, List, Optional
+from typing import Any, List
 
 from chainermn_tpu.communicators.base import CommunicatorBase
 from chainermn_tpu.utils import native

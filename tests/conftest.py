@@ -102,6 +102,17 @@ def mesh(request, devices8):
     return build_mesh(inter_size=inter, intra_size=intra, devices=devices8)
 
 
+@pytest.fixture
+def lint_clean():
+    """The static collective linter's assertion surface
+    (docs/static_analysis.md): ``lint_clean(step, params, state, batch,
+    comm=comm)`` raises ``LintError`` with the full report when any rule
+    R001–R005 flags the step."""
+    from chainermn_tpu.analysis import assert_lint_clean
+
+    return assert_lint_clean
+
+
 def subprocess_env(n_devices: int = 8) -> dict:
     """Environment for spawning REAL worker/example subprocesses on the
     virtual CPU mesh: scrub the axon TPU plugin trigger, force the CPU

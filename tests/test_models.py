@@ -209,8 +209,11 @@ def test_transformer_lm_remat_same_loss_and_grads():
 
     rng = np.random.RandomState(1)
     tokens = jnp.asarray(rng.randint(0, 32, size=(2, 8)), jnp.int32)
+    # fp32: remat recomputes the forward, which reorders the bf16
+    # accumulations — "same math" only holds at a precision where the
+    # reassociation is below the rtol/atol used here.
     base = dict(vocab=32, d_model=16, n_heads=2, d_ff=32, n_layers=2,
-                max_len=8)
+                max_len=8, dtype=jnp.float32)
     lm = TransformerLM(**base)
     lm_r = TransformerLM(**base, remat=True)
     params = lm.init(jax.random.PRNGKey(0), tokens)["params"]
