@@ -398,6 +398,119 @@ def bench_lm(comm, args):
     return result
 
 
+def bench_serve(comm, args):
+    """Decode throughput through the serving stack: synthetic request
+    traffic into the queue frontend, continuous-batched decode via the
+    scheduler, tokens/sec and per-token latency percentiles per decode
+    batch size.  Greedy sampling (the RNG never runs) so the measured
+    path is exactly the jitted prefill/decode data plane.
+
+    Unlike the train benches this sweep is host-loop inclusive by
+    design: serving throughput IS prefill+decode+scheduling, and the
+    per-token p50/p99 spread is the continuous-batching story (token
+    gaps stay flat as the batch grows until the decode step saturates).
+    """
+    from chainermn_tpu.serving import (
+        ContinuousBatchingScheduler,
+        EngineConfig,
+        InferenceEngine,
+        SamplingParams,
+        ServeFrontend,
+    )
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    cfg = dict(
+        vocab=args.lm_vocab, d_model=args.lm_d_model,
+        n_heads=args.lm_heads, d_ff=args.lm_d_ff,
+        n_layers=args.lm_layers, max_len=args.serve_max_len,
+    )
+    model = TransformerLM(**cfg)
+    rng = np.random.RandomState(0)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )
+
+    P, N = args.serve_prompt_len, args.serve_new_tokens
+    prompts = [
+        rng.randint(0, cfg["vocab"], size=P).tolist()
+        for _ in range(args.serve_requests)
+    ]
+    batch_sizes = [int(b) for b in args.serve_batch_sizes.split(",")]
+
+    sweep = []
+    for bs in batch_sizes:
+        ecfg = EngineConfig(
+            block_size=args.serve_block_size,
+            n_blocks=args.serve_blocks,
+            max_len=args.serve_max_len,
+            max_batch=bs,
+        )
+        engine = InferenceEngine(model, params, ecfg)
+        sched = ContinuousBatchingScheduler(engine)
+        fe = ServeFrontend(sched, max_queue=len(prompts) + 1)
+
+        # warmup: compile the buckets this sweep point will touch
+        fe.submit(prompts[0], N, sampling=SamplingParams())
+        fe.run_until_idle()
+
+        stamps = {}  # request_id -> [perf_counter per token]
+
+        def on_token(rid, tok, _s=stamps):
+            _s.setdefault(rid, []).append(time.perf_counter())
+
+        handles = []
+        t0 = time.perf_counter()
+        for p in prompts:
+            handles.append(
+                fe.submit(p, N, sampling=SamplingParams(),
+                          on_token=on_token)
+            )
+        fe.run_until_idle()
+        wall = time.perf_counter() - t0
+
+        total_tokens = sum(len(h.tokens) for h in handles)
+        gaps = []
+        for ts in stamps.values():
+            gaps.extend(b - a for a, b in zip(ts, ts[1:]))
+        gaps.sort()
+
+        def pct(q):
+            if not gaps:
+                return None
+            return gaps[min(len(gaps) - 1, int(q * len(gaps)))]
+
+        st = engine.stats()
+        res = sched.results()
+        sweep.append({
+            "batch_size": bs,
+            "tokens_per_sec": round(total_tokens / wall, 1),
+            "p50_token_latency_ms": round(pct(0.50) * 1e3, 3)
+            if gaps else None,
+            "p99_token_latency_ms": round(pct(0.99) * 1e3, 3)
+            if gaps else None,
+            "requests": len(handles),
+            "finished": sum(1 for h in handles
+                            if h.status == "finished"),
+            "preemptions": sum(r.preemptions for r in res.values()),
+            "prefill_compiles": st["prefill_compiles"],
+            "decode_compiles": st["decode_compiles"],
+        })
+
+    best = max(sweep, key=lambda r: r["tokens_per_sec"])
+    return {
+        "metric": "decode tokens/sec, continuous-batched serving "
+                  "(paged KV + jitted decode)",
+        "value": best["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "best_batch_size": best["batch_size"],
+        "config": {**cfg, "prompt_len": P, "new_tokens": N,
+                   "n_requests": args.serve_requests,
+                   "block_size": args.serve_block_size,
+                   "n_blocks": args.serve_blocks},
+        "sweep": sweep,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--only", choices=["resnet", "lm"], default=None,
@@ -449,6 +562,26 @@ def main(argv=None):
                          "tune cache), then bench with the chosen configs "
                          "pinned; the chosen (block_q, block_k, chunk) "
                          "land under the LM result's \"autotune\" key")
+    ap.add_argument("--serve", action="store_true",
+                    help="decode-throughput mode: synthetic request "
+                         "traffic through the serving stack (paged KV "
+                         "cache + continuous batching), tokens/sec and "
+                         "p50/p99 per-token latency per decode batch "
+                         "size; the LM geometry comes from the --lm-* "
+                         "flags")
+    ap.add_argument("--serve-batch-sizes", default="1,2,4,8",
+                    help="comma-separated decode batch sizes to sweep")
+    ap.add_argument("--serve-requests", type=int, default=16,
+                    help="synthetic requests per sweep point")
+    ap.add_argument("--serve-prompt-len", type=int, default=64)
+    ap.add_argument("--serve-new-tokens", type=int, default=32)
+    ap.add_argument("--serve-block-size", type=int, default=16,
+                    help="KV page size in tokens")
+    ap.add_argument("--serve-blocks", type=int, default=512,
+                    help="KV pages in the pool")
+    ap.add_argument("--serve-max-len", type=int, default=512,
+                    help="serving max sequence length (prompt + "
+                         "generated; also the model max_len)")
     ap.add_argument("--step-log", default=None, metavar="PATH",
                     help="write a JSONL event log of the bench run "
                          "(compile events, instrumented-step spans, the "
@@ -464,7 +597,9 @@ def main(argv=None):
 
         recorder = telemetry.enter_context(StepRecorder(args.step_log))
 
-    if args.only == "lm":
+    if args.serve:
+        out = bench_serve(comm, args)
+    elif args.only == "lm":
         out = bench_lm(comm, args)
     elif args.only == "resnet":
         out = bench_resnet(comm, args)

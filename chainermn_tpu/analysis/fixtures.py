@@ -1,10 +1,13 @@
 """Seeded-violation fixtures: one deliberately broken program per rule,
-plus the clean train step none of them may flag.
+plus the clean train step none of them may flag — and one deliberately
+CLEAN program (``serving_decode``, ``expect=None``) pinning that the
+serving engine's decode step stays collective-free.
 
 These are the linter's own regression corpus — ``python -m
 chainermn_tpu.tools.lint --fixtures`` lints them (and must exit
-nonzero), ``tests/test_analysis.py`` asserts each one is flagged with
-its expected rule id.  Every builder adapts to the available device
+nonzero — the violations dominate), ``tests/test_analysis.py`` asserts
+each one is flagged with its expected rule id (or flags nothing, for
+the clean entries).  Every builder adapts to the available device
 count, so the corpus runs on the 8-device virtual CPU mesh and on a
 single real chip alike.
 """
@@ -158,12 +161,55 @@ def fixture_r005() -> dict:
     )
 
 
+def fixture_serving_decode() -> dict:
+    """The serving engine's jitted single-token decode step — a CLEAN
+    fixture (``expect=None``): the decode data plane must stay
+    collective-free.  Every reduction in paged attention is per-sequence
+    (one request's softmax must not see another's keys), so ANY
+    cross-device collective in this program is a bug the linter should
+    make loud; the fixture keeps the corpus honest about programs that
+    are supposed to have an empty finding list."""
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    geom = dict(vocab=32, d_model=16, n_heads=2, d_ff=32, n_layers=1,
+                max_len=16, page_count=8, page_size=4)
+    model = TransformerLM(**geom, paged="decode")
+    B, W = 2, 4
+    tokens = jnp.zeros((B,), jnp.int32)
+    tables = jnp.zeros((B, W), jnp.int32)
+    lens = jnp.zeros((B,), jnp.int32)
+    variables = model.init(
+        jax.random.PRNGKey(0), tokens[:, None],
+        position_offset=lens[:, None], block_tables=tables,
+        seq_lens=lens,
+    )
+    params, cache = variables["params"], variables["cache"]
+
+    def decode_step(params, cache, tokens, tables, lens):
+        logits, upd = model.apply(
+            {"params": params, "cache": cache}, tokens[:, None],
+            position_offset=lens[:, None], block_tables=tables,
+            seq_lens=lens, mutable=["cache"],
+        )
+        return logits[:, 0].astype(jnp.float32), upd["cache"]
+
+    # donate_argnums=(1,) mirrors the real engine: each decode consumes
+    # the previous step's cache, so the pages update in place — and the
+    # donation audit (R005) holds the fixture to it.
+    return dict(
+        target="serving_decode", expect=None,
+        fn=jax.jit(decode_step, donate_argnums=(1,)),
+        args=(params, cache, tokens, tables, lens), kwargs={}, comm=None,
+    )
+
+
 FIXTURES: Dict[str, Callable[[], dict]] = {
     "r001": fixture_r001,
     "r002": fixture_r002,
     "r003": fixture_r003,
     "r004": fixture_r004,
     "r005": fixture_r005,
+    "serving_decode": fixture_serving_decode,
 }
 
 

@@ -22,6 +22,19 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _tuned_block_ctx(page_count, page_size, n_kv, d_head, dtype):
+    """Tuned context-gather chunk (in pages) for paged decode attention.
+    ``None`` (one-shot gather) when the tune cache has no entry — and
+    always off-TPU / under pytest, where tuning lookups are inert, so CPU
+    decode numerics never depend on the cache."""
+    from chainermn_tpu.tuning import lookup_decode_block_ctx
+
+    return lookup_decode_block_ctx(
+        n_pages=page_count, page_size=page_size, n_kv=n_kv,
+        d_head=d_head, dtype=dtype,
+    )
+
+
 def sinusoidal_positions(max_len: int, d_model: int) -> np.ndarray:
     pos = np.arange(max_len)[:, None]
     div = np.exp(np.arange(0, d_model, 2) * (-np.log(10000.0) / d_model))
@@ -40,9 +53,16 @@ class MultiHeadAttention(nn.Module):
     cache_len: int = 0          # cache capacity (max sequence length)
     n_kv_heads: Optional[int] = None  # GQA/MQA: fewer K/V heads (divides
                                       # n_heads; None = MHA)
+    paged: Optional[str] = None  # paged KV cache (serving): None |
+                                 # "prefill" (write whole prompt, dense
+                                 # causal attention) | "decode" (write one
+                                 # token, paged single-query attention)
+    page_count: int = 0          # number of cache pages (paged modes)
+    page_size: int = 0           # tokens per page (paged modes)
 
     @nn.compact
-    def __call__(self, q_in, kv_in, mask=None):
+    def __call__(self, q_in, kv_in, mask=None, *, block_tables=None,
+                 seq_lens=None):
         d_head = self.d_model // self.n_heads
         n_kv = self.n_kv_heads or self.n_heads
         if self.n_heads % n_kv:
@@ -55,6 +75,85 @@ class MultiHeadAttention(nn.Module):
         q = dense("query", self.n_heads)(q_in)
         k = dense("key", n_kv)(kv_in)
         v = dense("value", n_kv)(kv_in)
+
+        if self.paged is not None:
+            # Paged KV cache (serving, docs/serving.md): K/V live in
+            # fixed-size pages indexed by a per-sequence block table, so
+            # sequences of different lengths share one physical cache and
+            # grow in O(page_size) quanta.  Same "cache" collection idiom
+            # (and the same param structure) as the dense decode path
+            # below, so trained params drop in unchanged.
+            from chainermn_tpu.ops.decode_attention import (
+                paged_attention_decode,
+                write_prompt_pages,
+                write_token_pages,
+            )
+
+            if self.decode:
+                raise ValueError(
+                    "paged and decode are mutually exclusive KV cache "
+                    "modes: the dense cache keeps one scalar index for "
+                    "the whole batch, pages keep per-sequence lengths"
+                )
+            if self.attention_fn is not None:
+                raise ValueError(
+                    "paged modes are incompatible with attention_fn: the "
+                    "pluggable adapters ignore the cache mask and would "
+                    "attend to the wrong page slots"
+                )
+            if self.paged not in ("prefill", "decode"):
+                raise ValueError(
+                    f"paged must be 'prefill' or 'decode', got "
+                    f"{self.paged!r}"
+                )
+            if self.page_count <= 0 or self.page_size <= 0:
+                raise ValueError("paged modes require page_count > 0 and "
+                                 "page_size > 0")
+            if block_tables is None or seq_lens is None:
+                raise ValueError(
+                    "paged modes require block_tables and seq_lens"
+                )
+            pages = (self.page_count, self.page_size, n_kv, d_head)
+            pk = self.variable(
+                "cache", "k_pages", lambda: jnp.zeros(pages, k.dtype)
+            )
+            pv = self.variable(
+                "cache", "v_pages", lambda: jnp.zeros(pages, v.dtype)
+            )
+            if self.paged == "prefill":
+                # Write the whole prompt's K/V (padding positions beyond
+                # seq_lens route to the invalid page and are dropped);
+                # the attention itself is the ordinary dense causal path
+                # over the local K/V — the prompt IS the whole context.
+                pk.value = write_prompt_pages(
+                    pk.value, k, block_tables, seq_lens
+                )
+                pv.value = write_prompt_pages(
+                    pv.value, v, block_tables, seq_lens
+                )
+            else:
+                if q.shape[1] != 1:
+                    raise ValueError(
+                        f"paged decode consumes exactly one token per "
+                        f"call, got a length-{q.shape[1]} chunk"
+                    )
+                pk.value = write_token_pages(
+                    pk.value, k, block_tables, seq_lens
+                )
+                pv.value = write_token_pages(
+                    pv.value, v, block_tables, seq_lens
+                )
+                out = paged_attention_decode(
+                    q, pk.value, pv.value, block_tables, seq_lens + 1,
+                    block_ctx=_tuned_block_ctx(
+                        self.page_count, self.page_size, n_kv, d_head,
+                        q.dtype,
+                    ),
+                )
+                return nn.DenseGeneral(
+                    self.d_model, axis=(-2, -1), dtype=self.dtype,
+                    name="out", use_bias=False,
+                )(out)
 
         if self.decode:
             # KV cache (flax "cache" collection): one new token per call is
@@ -144,15 +243,19 @@ class EncoderLayer(nn.Module):
     decode: bool = False
     cache_len: int = 0
     n_kv_heads: Optional[int] = None
+    paged: Optional[str] = None
+    page_count: int = 0
+    page_size: int = 0
 
     @nn.compact
-    def __call__(self, x, mask=None):
+    def __call__(self, x, mask=None, *, block_tables=None, seq_lens=None):
         h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + MultiHeadAttention(
             self.d_model, self.n_heads, self.dtype, self.attention_fn,
             decode=self.decode, cache_len=self.cache_len,
-            n_kv_heads=self.n_kv_heads,
-        )(h, h, mask)
+            n_kv_heads=self.n_kv_heads, paged=self.paged,
+            page_count=self.page_count, page_size=self.page_size,
+        )(h, h, mask, block_tables=block_tables, seq_lens=seq_lens)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         return x + FeedForward(self.d_model, self.d_ff, self.dtype)(h)
 
@@ -236,16 +339,28 @@ class TransformerLM(nn.Module):
     decode: bool = False        # KV-cache incremental decoding (generate())
     remat: bool = False         # rematerialize each layer in backward
     n_kv_heads: Optional[int] = None  # GQA/MQA (divides n_heads)
+    paged: Optional[str] = None  # paged KV cache (serving engine):
+                                 # "prefill" | "decode" — see
+                                 # MultiHeadAttention.paged
+    page_count: int = 0
+    page_size: int = 0
 
     @nn.compact
     def __call__(self, tokens, position_offset=None, return_hidden=False,
-                 inputs_embeds=None):
+                 inputs_embeds=None, block_tables=None, seq_lens=None):
         """``position_offset``: global position of this shard's first token —
         pass ``axis_index * S_local`` when the sequence dimension is sharded
         (sequence parallelism); requires a sequence-aware ``attention_fn``
         (ring/Ulysses), since the dense path's causal mask is local.
         Alternatively a ``(S_local,)`` int array of explicit global
-        positions, for non-contiguous shard layouts (zigzag ring).
+        positions, for non-contiguous shard layouts (zigzag ring) — or a
+        ``(B, S)`` int array of PER-SEQUENCE positions, which is how the
+        serving engine's paged decode step places each sequence's next
+        token at its own context length.
+
+        ``block_tables``/``seq_lens``: the paged-KV-cache routing inputs,
+        required (and only meaningful) when ``paged`` is set — see
+        :class:`MultiHeadAttention` and docs/serving.md.
 
         ``return_hidden=True`` returns the final-norm hidden states
         ``(B, S, d_model)`` instead of logits — the input for
@@ -270,6 +385,8 @@ class TransformerLM(nn.Module):
         S = tokens.shape[1]
         if position_offset is None:
             pos = pe[:S]
+        elif getattr(position_offset, "ndim", 0) == 2:
+            pos = pe[position_offset]      # (B, S) per-sequence positions
         elif getattr(position_offset, "ndim", 0):
             pos = pe[position_offset]      # explicit per-token positions
         else:
@@ -289,7 +406,10 @@ class TransformerLM(nn.Module):
                 )
             embed = None
             x = inputs_embeds.astype(self.dtype)
-        x = x + pos[None].astype(self.dtype)
+        if pos.ndim == 3:                  # (B, S, d): already per-batch
+            x = x + pos.astype(self.dtype)
+        else:
+            x = x + pos[None].astype(self.dtype)
         # Pluggable attention (flash/ring/ulysses) imposes its own
         # causality and ignores the mask argument — skip materializing
         # the (S, S) mask, which at long context is the largest host
@@ -304,8 +424,9 @@ class TransformerLM(nn.Module):
                 self.d_model, self.n_heads, self.d_ff, self.dtype,
                 self.attention_fn, name=f"layer_{i}",
                 decode=self.decode, cache_len=self.max_len if self.decode else 0,
-                n_kv_heads=self.n_kv_heads,
-            )(x, mask)
+                n_kv_heads=self.n_kv_heads, paged=self.paged,
+                page_count=self.page_count, page_size=self.page_size,
+            )(x, mask, block_tables=block_tables, seq_lens=seq_lens)
         x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
         if return_hidden:
             return x

@@ -23,6 +23,11 @@ cross-host reduction is lossless:
 * **histogram** — ``histogram_observe(name, v)`` buckets ``v`` into
   power-of-two bins (log2 of the upper bound), the standard
   latency-histogram shape; bucket counts sum across hosts.
+* **gauge** — ``gauge(name, v)`` a set-style level (queue depth, cache
+  occupancy): the LAST value wins locally — re-setting replaces, never
+  accumulates — and ranks merge to ``sum`` with ``min``/``max``, the
+  natural reading for capacity-like levels (total in-flight across the
+  job, plus the most/least loaded rank).
 
 A module-level *current reporter* stack (``scope``/``get_reporter``/
 ``report``) mirrors the reference's ``reporter.report({...})`` idiom so
@@ -72,6 +77,35 @@ class _Scalar:
         return out
 
 
+class _Gauge:
+    """Merge-side accumulator for set-style gauges.  A single rank's
+    snapshot is ``{"value": v, "sum": v, "min": v, "max": v, "n": 1}``;
+    merging sums ``sum``/``n`` and spreads ``min``/``max`` — composable,
+    so a merge of merges equals one flat merge."""
+
+    __slots__ = ("value", "sum", "min", "max", "n")
+
+    def __init__(self):
+        self.value = 0.0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self.n = 0
+
+    def merge(self, d: Mapping):
+        if d.get("n", 0) == 0:
+            return
+        self.value = d["value"]  # merge order = rank order
+        self.sum += d["sum"]
+        self.min = min(self.min, d["min"])
+        self.max = max(self.max, d["max"])
+        self.n += d["n"]
+
+    def snapshot(self) -> dict:
+        return {"value": self.value, "sum": self.sum, "min": self.min,
+                "max": self.max, "n": self.n}
+
+
 def _bucket(v: float) -> int:
     """Histogram bucket id: ceil(log2(v)) clamped into [-30, 63] (bucket b
     covers (2^(b-1), 2^b]); non-positive values land in the lowest bucket."""
@@ -89,6 +123,7 @@ class Reporter:
         self._scalars: Dict[str, _Scalar] = {}
         self._counters: Dict[str, float] = {}
         self._hists: Dict[str, Dict[int, int]] = {}
+        self._gauges: Dict[str, float] = {}
 
     # -- write side ----------------------------------------------------
     def observe(self, name: str, value) -> None:
@@ -108,6 +143,13 @@ class Reporter:
         with self._lock:
             h = self._hists.setdefault(name, {})
             h[b] = h.get(b, 0) + 1
+
+    def gauge(self, name: str, value) -> None:
+        """Set a level gauge (queue depth, cache occupancy): last value
+        wins — setting replaces the previous value, never accumulates."""
+        v = float(value)
+        with self._lock:
+            self._gauges[name] = v
 
     def report(self, values: Mapping[str, float]) -> None:
         """Batch scalar observations — the reference's ``report({...})``."""
@@ -130,6 +172,10 @@ class Reporter:
                     k: {str(b): c for b, c in h.items()}
                     for k, h in self._hists.items()
                 },
+                "gauges": {
+                    k: {"value": v, "sum": v, "min": v, "max": v, "n": 1}
+                    for k, v in self._gauges.items()
+                },
             }
 
     def reset(self) -> None:
@@ -137,6 +183,7 @@ class Reporter:
             self._scalars.clear()
             self._counters.clear()
             self._hists.clear()
+            self._gauges.clear()
 
     # -- cross-host ----------------------------------------------------
     def aggregate(self, comm, reset: bool = False) -> dict:
@@ -169,6 +216,7 @@ def merge_summaries(snapshots) -> dict:
     scalars: Dict[str, _Scalar] = {}
     counters: Dict[str, float] = {}
     hists: Dict[str, Dict[str, int]] = {}
+    gauges: Dict[str, _Gauge] = {}
     for snap in snapshots:
         for k, d in snap.get("scalars", {}).items():
             scalars.setdefault(k, _Scalar()).merge(d)
@@ -178,10 +226,13 @@ def merge_summaries(snapshots) -> dict:
             out = hists.setdefault(k, {})
             for b, c in h.items():
                 out[b] = out.get(b, 0) + c
+        for k, d in snap.get("gauges", {}).items():
+            gauges.setdefault(k, _Gauge()).merge(d)
     return {
         "scalars": {k: s.snapshot() for k, s in scalars.items()},
         "counters": counters,
         "histograms": hists,
+        "gauges": {k: g.snapshot() for k, g in gauges.items()},
     }
 
 

@@ -6,6 +6,10 @@ earn hand treatment: attention (the Pallas flash kernels — FLOPs and
 O(S²) memory) and the LM loss head (the chunked fused cross-entropy —
 a custom-vjp memory transform that never materializes the logits).
 Everything else XLA fuses well.
+
+The serving tier adds a third: paged single-query decode attention
+(``decode_attention``) — gather-by-block-table K/V plus the page-write
+scatters, the inference analogue of flash attention's training role.
 """
 
 from chainermn_tpu.ops.flash_attention import (  # noqa: F401
@@ -15,4 +19,10 @@ from chainermn_tpu.ops.flash_attention import (  # noqa: F401
 from chainermn_tpu.ops.fused_ce import (  # noqa: F401
     fused_cross_entropy,
     fused_cross_entropy_with_lse,
+)
+from chainermn_tpu.ops.decode_attention import (  # noqa: F401
+    invalid_block,
+    paged_attention_decode,
+    write_prompt_pages,
+    write_token_pages,
 )

@@ -1,0 +1,49 @@
+"""Serving subsystem: paged KV cache, continuous batching, jitted decode.
+
+Training repos usually bolt inference on as an afterthought; this
+package is the deliberate version — the smallest serving stack that
+exercises the repo's own model (:class:`~chainermn_tpu.models.transformer
+.TransformerLM`) with production-shaped mechanics:
+
+* :mod:`~chainermn_tpu.serving.kv_cache` — paged KV accounting:
+  fixed-size pages, per-sequence block tables, alloc/free/defragment,
+  conservation invariants, occupancy stats (vLLM's PagedAttention
+  memory model, host side);
+* :mod:`~chainermn_tpu.serving.engine` — the execution engine: jitted
+  prefill and single-token decode with static padding buckets (bounded
+  recompiles), the paged-attention data plane from
+  :mod:`~chainermn_tpu.ops.decode_attention` (CPU-safe, tuned gather
+  chunks on TPU), host-side deterministic sampling;
+* :mod:`~chainermn_tpu.serving.scheduler` — Orca-style iteration-level
+  continuous batching: FCFS admission with a free-page watermark, one
+  batched decode per step, preemption by eviction with recompute;
+* :mod:`~chainermn_tpu.serving.frontend` — bounded-queue submission
+  with backpressure, per-request deadlines, streaming token callbacks.
+
+The load-bearing property, pinned by ``tests/test_serving.py``: a token
+stream is bit-identical whether a request runs alone through
+:meth:`engine.InferenceEngine.generate` or shares continuous-batched
+iterations (including across preemption) — batching is a pure
+throughput decision, never a quality one.
+"""
+
+from chainermn_tpu.serving.engine import (  # noqa: F401
+    EngineConfig,
+    InferenceEngine,
+    SamplingParams,
+)
+from chainermn_tpu.serving.frontend import (  # noqa: F401
+    QueueFull,
+    RequestHandle,
+    ServeFrontend,
+)
+from chainermn_tpu.serving.kv_cache import (  # noqa: F401
+    CacheStats,
+    OutOfBlocks,
+    PagedKVCache,
+)
+from chainermn_tpu.serving.scheduler import (  # noqa: F401
+    ContinuousBatchingScheduler,
+    Request,
+    RequestState,
+)

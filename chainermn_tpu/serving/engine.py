@@ -1,0 +1,371 @@
+"""Jitted inference engine: bucketed prefill + single-token paged decode.
+
+The engine is the *execution* half of serving (the scheduler is the
+*policy* half): it owns the device-side KV pages, the two jitted step
+programs, and sampling.  Design constraints, in order:
+
+1. **Bit-stable batching.**  A token stream must not depend on which
+   other requests happened to share its decode batch — that is what lets
+   the scheduler batch aggressively while `tests/test_serving.py` pins
+   batched == sequential.  Everything per-sequence: the paged attention
+   reduces only within one sequence's gathered context, padding rows
+   write to the dropped invalid page, and sampling is host-side per
+   request (greedy argmax on fp32 logits; temperature/top-k from a
+   per-request counter-based RNG independent of batch composition).
+2. **Bounded recompiles.**  jit re-traces per shape, so every host-side
+   shape is padded to a static bucket: prompt length (pow2 ladder),
+   decode batch (pow2 up to ``max_batch``), and block-table width (pow2
+   pages).  The compile count is the number of *buckets touched*, not
+   the number of requests — pinned by the recompile-count test.
+3. **CPU-safe.**  The data plane is pure jnp (gather/scatter + einsum
+   softmax, :mod:`chainermn_tpu.ops.decode_attention`), so the tier-1
+   suite runs the whole engine under ``JAX_PLATFORMS=cpu``; on TPU the
+   same program picks up the tuned gather chunk
+   (``tuning.lookup_decode_block_ctx``) with identical numerics.
+
+The decode data plane is collective-free by construction — no psum ever
+belongs in a per-sequence cache read — and stays that way via the
+``serving_decode`` lint fixture and the
+``tests/golden/serving_decode_census.json`` golden.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from chainermn_tpu.models.transformer import TransformerLM
+from chainermn_tpu.serving.kv_cache import PagedKVCache
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling policy.  ``temperature == 0`` is greedy
+    (argmax, RNG never consulted); otherwise softmax sampling at the
+    given temperature, optionally truncated to the ``top_k`` most likely
+    tokens.  ``seed`` plus the token position form a counter-based RNG,
+    so a request's stream is reproducible and independent of batching."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        if self.top_k < 0:
+            raise ValueError("top_k must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Static geometry of the serving engine.
+
+    ``n_blocks * block_size`` is the total KV pool in tokens;
+    ``max_len`` bounds any single sequence (prompt + generated);
+    ``max_batch`` is the widest decode iteration.  Buckets are pow2
+    ladders derived from these unless given explicitly."""
+
+    block_size: int = 16
+    n_blocks: int = 256
+    max_len: int = 2048
+    max_batch: int = 8
+    prefill_buckets: Optional[Tuple[int, ...]] = None
+    batch_buckets: Optional[Tuple[int, ...]] = None
+    table_width_buckets: Optional[Tuple[int, ...]] = None
+
+    def resolved(self) -> "EngineConfig":
+        def pow2_ladder(lo, hi):
+            out, v = [], lo
+            while v < hi:
+                out.append(v)
+                v *= 2
+            out.append(hi)
+            return tuple(sorted(set(out)))
+
+        max_pages = -(-self.max_len // self.block_size)
+        return dataclasses.replace(
+            self,
+            prefill_buckets=self.prefill_buckets
+            or pow2_ladder(min(16, self.max_len), self.max_len),
+            batch_buckets=self.batch_buckets
+            or pow2_ladder(1, self.max_batch),
+            table_width_buckets=self.table_width_buckets
+            or pow2_ladder(1, max_pages),
+        )
+
+
+def _bucket(value: int, buckets: Tuple[int, ...], what: str) -> int:
+    for b in buckets:
+        if value <= b:
+            return b
+    raise ValueError(f"{what} {value} exceeds the largest bucket "
+                     f"{buckets[-1]}")
+
+
+class InferenceEngine:
+    """Cached-KV inference over a trained :class:`TransformerLM`.
+
+    ``lm`` is the model the ``params`` were trained with (any ``decode``
+    / ``paged`` setting — prefill and decode twins are constructed here,
+    sharing the trained parameter structure).  The engine owns:
+
+    * ``kv`` — the :class:`PagedKVCache` page accounting;
+    * the device pages (flax ``cache`` collection of both twins);
+    * the two jitted steps and their bucket bookkeeping.
+    """
+
+    def __init__(self, lm: TransformerLM, params,
+                 config: Optional[EngineConfig] = None):
+        cfg = (config or EngineConfig(max_len=lm.max_len)).resolved()
+        if cfg.max_len > lm.max_len:
+            raise ValueError(
+                f"config.max_len {cfg.max_len} exceeds the model's "
+                f"max_len {lm.max_len}"
+            )
+        self.config = cfg
+        self.params = params["params"] if "params" in params else params
+        self.lm = lm
+        self.kv = PagedKVCache(cfg.n_blocks, cfg.block_size)
+
+        twin = dict(
+            vocab=lm.vocab, d_model=lm.d_model, n_heads=lm.n_heads,
+            d_ff=lm.d_ff, n_layers=lm.n_layers, max_len=lm.max_len,
+            dtype=lm.dtype, n_kv_heads=lm.n_kv_heads,
+            page_count=cfg.n_blocks, page_size=cfg.block_size,
+        )
+        self._prefill_model = TransformerLM(**twin, paged="prefill")
+        self._decode_model = TransformerLM(**twin, paged="decode")
+
+        # Cache geometry without allocating a throwaway param set; zeros
+        # ARE the empty pages (every table slot starts invalid, so stale
+        # page contents are unreachable anyway).
+        W0 = cfg.table_width_buckets[0]
+        cache_shapes = jax.eval_shape(
+            lambda: self._prefill_model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32),
+                block_tables=jnp.zeros((1, W0), jnp.int32),
+                seq_lens=jnp.zeros((1,), jnp.int32),
+            )["cache"]
+        )
+        self._cache = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+        )
+
+        def prefill_step(params, cache, tokens, block_tables, seq_lens):
+            logits, upd = self._prefill_model.apply(
+                {"params": params, "cache": cache}, tokens,
+                block_tables=block_tables, seq_lens=seq_lens,
+                mutable=["cache"],
+            )
+            # Logits of the LAST PROMPT TOKEN per row — what samples the
+            # first generated token.  (Padding rows index position 0 of
+            # garbage; callers never read them.)
+            idx = jnp.maximum(seq_lens - 1, 0)[:, None, None]
+            last = jnp.take_along_axis(
+                logits, jnp.broadcast_to(
+                    idx, (logits.shape[0], 1, logits.shape[2])
+                ), axis=1,
+            )[:, 0]
+            return last.astype(jnp.float32), upd["cache"]
+
+        def decode_step(params, cache, tokens, block_tables, seq_lens):
+            logits, upd = self._decode_model.apply(
+                {"params": params, "cache": cache}, tokens[:, None],
+                position_offset=jnp.maximum(seq_lens, 0)[:, None],
+                block_tables=block_tables, seq_lens=seq_lens,
+                mutable=["cache"],
+            )
+            return logits[:, 0].astype(jnp.float32), upd["cache"]
+
+        # donate the pages: each step consumes the previous step's cache,
+        # so the (large) page buffers update in place where the backend
+        # supports aliasing.
+        self._prefill_jit = jax.jit(prefill_step, donate_argnums=(1,))
+        self._decode_jit = jax.jit(decode_step, donate_argnums=(1,))
+        self._prefill_shapes: set = set()
+        self._decode_shapes: set = set()
+        self._tokens_decoded = 0
+        self._tokens_prefilled = 0
+
+    # -- geometry ------------------------------------------------------
+    @property
+    def max_batch(self) -> int:
+        return self.config.max_batch
+
+    def table_width(self, n_tokens: int) -> int:
+        """Bucketed block-table width for a context of ``n_tokens``."""
+        return _bucket(
+            max(1, self.kv.blocks_for(n_tokens)),
+            self.config.table_width_buckets, "table width",
+        )
+
+    # -- steps ---------------------------------------------------------
+    def prefill(self, token_ids, seq_id) -> np.ndarray:
+        """Run one prompt (host int sequence) through the prefill step,
+        writing its K/V into the pages of the already-allocated
+        ``seq_id``.  Returns the fp32 (vocab,) logits of the last prompt
+        token.  One sequence per call: per-request prefill keeps the
+        compiled shapes to one ladder and the token stream independent
+        of co-arrivals."""
+        toks = np.asarray(token_ids, np.int32).reshape(-1)
+        L = len(toks)
+        if L == 0:
+            raise ValueError("empty prompt")
+        if L >= self.config.max_len:
+            raise ValueError(
+                f"prompt of {L} tokens leaves no room to generate within "
+                f"max_len {self.config.max_len}"
+            )
+        S = _bucket(L, self.config.prefill_buckets, "prompt length")
+        W = self.table_width(L)
+        padded = np.zeros((1, S), np.int32)
+        padded[0, :L] = toks
+        table = self.kv.padded_table(seq_id, W)[None]
+        self._prefill_shapes.add((S, W))
+        last, self._cache = self._prefill_jit(
+            self.params, self._cache, jnp.asarray(padded),
+            jnp.asarray(table), jnp.asarray([L], np.int32),
+        )
+        self._tokens_prefilled += L
+        return np.asarray(last[0])
+
+    def decode(self, tokens, seq_ids, seq_lens) -> np.ndarray:
+        """One decode iteration: for each running sequence, write the
+        given (just-sampled) token at position ``seq_lens[i]`` and
+        return the fp32 (B, vocab) logits predicting the next one.
+
+        ``tokens``/``seq_ids``/``seq_lens`` are parallel host lists; the
+        batch is padded to its pow2 bucket with inert rows (invalid
+        tables, ``seq_len = -1`` → the page write drops, the gather
+        masks to nothing).
+        """
+        B = len(tokens)
+        if B == 0:
+            raise ValueError("empty decode batch")
+        if B > self.config.max_batch:
+            raise ValueError(
+                f"decode batch {B} exceeds max_batch "
+                f"{self.config.max_batch}"
+            )
+        Bp = _bucket(B, self.config.batch_buckets, "decode batch")
+        W = max(
+            self.table_width(int(l) + 1) for l in seq_lens
+        )
+        tok = np.zeros((Bp,), np.int32)
+        tok[:B] = np.asarray(tokens, np.int32)
+        lens = np.full((Bp,), -1, np.int32)
+        lens[:B] = np.asarray(seq_lens, np.int32)
+        tables = np.full((Bp, W), self.kv.invalid, np.int32)
+        for i, sid in enumerate(seq_ids):
+            tables[i] = self.kv.padded_table(sid, W)
+        self._decode_shapes.add((Bp, W))
+        logits, self._cache = self._decode_jit(
+            self.params, self._cache, jnp.asarray(tok),
+            jnp.asarray(tables), jnp.asarray(lens),
+        )
+        self._tokens_decoded += B
+        return np.asarray(logits[:B])
+
+    # -- sampling ------------------------------------------------------
+    @staticmethod
+    def sample(logits: np.ndarray, params: SamplingParams,
+               position: int) -> int:
+        """Sample one token from fp32 (vocab,) logits.  Greedy at
+        ``temperature == 0`` (np.argmax — deterministic, first-max on
+        ties).  Otherwise counter-based: the RNG is seeded from
+        ``(seed, position)`` alone, so the draw does not depend on batch
+        composition, scheduling order, or preemption history."""
+        if params.temperature == 0.0:
+            return int(np.argmax(logits))
+        z = logits.astype(np.float64) / params.temperature
+        if params.top_k:
+            k = min(params.top_k, z.shape[-1])
+            cutoff = np.partition(z, -k)[-k]
+            z = np.where(z >= cutoff, z, -np.inf)
+        z = z - z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        rng = np.random.default_rng((int(params.seed), int(position)))
+        return int(rng.choice(p.shape[-1], p=p))
+
+    # -- maintenance ---------------------------------------------------
+    def defragment(self) -> int:
+        """Compact the page pool (see :meth:`PagedKVCache.defragment`)
+        and permute the device pages to match.  Returns the number of
+        pages moved (0 = already compact, no device copy)."""
+        perm = self.kv.defragment()
+        if perm is None:
+            return 0
+        iperm = jnp.asarray(perm)
+
+        def permute(leaf):
+            # every cache leaf is a page array: (n_blocks, bs, n_kv, d)
+            return jnp.take(leaf, iperm, axis=0)
+
+        self._cache = jax.tree.map(permute, self._cache)
+        return int(self.kv._last_defrag_moves)
+
+    def reset(self) -> None:
+        """Drop every sequence and zero the accounting (device pages are
+        left as-is — unreachable without a table entry)."""
+        for sid in self.kv.seq_ids():
+            self.kv.free(sid)
+
+    # -- introspection -------------------------------------------------
+    def stats(self) -> dict:
+        """Occupancy + compile bookkeeping (the recompile-count test's
+        surface, and the scheduler's gauge source)."""
+        out = {
+            "cache": self.kv.stats().as_dict(),
+            "prefill_compiles": len(self._prefill_shapes),
+            "decode_compiles": len(self._decode_shapes),
+            "prefill_shapes": sorted(self._prefill_shapes),
+            "decode_shapes": sorted(self._decode_shapes),
+            "tokens_prefilled": self._tokens_prefilled,
+            "tokens_decoded": self._tokens_decoded,
+        }
+        # Cross-check against jit's own cache where the API exists.
+        for name, fn in (("prefill", self._prefill_jit),
+                         ("decode", self._decode_jit)):
+            try:
+                out[f"{name}_jit_cache_size"] = fn._cache_size()
+            except Exception:
+                pass
+        return out
+
+    # -- convenience ---------------------------------------------------
+    def generate(self, prompt, max_new_tokens: int,
+                 sampling: Optional[SamplingParams] = None,
+                 stop_token: Optional[int] = None) -> List[int]:
+        """Single-request generation through the SAME prefill/decode
+        machinery the scheduler drives — the sequential oracle the
+        continuous-batching parity test compares against, and the
+        simplest way to smoke-test an engine."""
+        sp = sampling or SamplingParams()
+        toks = list(np.asarray(prompt, np.int32).reshape(-1))
+        L = len(toks)
+        total = min(L + max_new_tokens, self.config.max_len)
+        sid = object()
+        self.kv.allocate(sid, L)
+        try:
+            logits = self.prefill(toks, sid)
+            out: List[int] = []
+            cur = L
+            while cur < total:
+                nxt = self.sample(logits, sp, cur)
+                out.append(nxt)
+                if stop_token is not None and nxt == stop_token:
+                    break
+                if cur + 1 >= total:
+                    break
+                self.kv.extend(sid, cur + 1)
+                logits = self.decode([nxt], [sid], [cur])[0]
+                cur += 1
+            return out
+        finally:
+            self.kv.free(sid)

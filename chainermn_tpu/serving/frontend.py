@@ -1,0 +1,202 @@
+"""Queue frontend: submission, backpressure, deadlines, streaming.
+
+The frontend is the boundary between callers and the scheduler loop:
+
+* :meth:`ServeFrontend.submit` turns (prompt, options) into a
+  :class:`RequestHandle` or raises :class:`QueueFull` — bounded-queue
+  backpressure, so a bursty producer finds out *at submission time*
+  rather than growing an unbounded backlog;
+* per-request deadlines: a request that exceeds its ``timeout_s``
+  (measured from submission, via an injectable clock so tests don't
+  sleep) is cancelled wherever it is — dropped from the queue, or
+  evicted mid-decode — and its handle reports ``timeout``;
+* streaming: ``on_token`` callbacks fire per sampled token from inside
+  the scheduler step, before the request completes.
+
+The frontend never spawns threads — :meth:`step` advances everything by
+one scheduler iteration and the caller owns the loop (`run_until_idle`
+for batch jobs, an external event loop for a real server).  That keeps
+the whole serving stack deterministic and testable in-process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional
+
+from chainermn_tpu.serving.engine import SamplingParams
+from chainermn_tpu.serving.scheduler import (
+    ContinuousBatchingScheduler,
+    Request,
+    RequestState,
+)
+
+
+class QueueFull(RuntimeError):
+    """Backpressure: the admission queue is at capacity.  Callers
+    should retry after draining some completions (or shed load)."""
+
+
+@dataclasses.dataclass
+class RequestHandle:
+    """Caller-side view of a submitted request."""
+
+    request_id: int
+    submitted_at: float
+    timeout_s: Optional[float]
+    _request: Request
+    finished_at: Optional[float] = None
+    timed_out: bool = False
+
+    @property
+    def done(self) -> bool:
+        return self.timed_out or self._request.done
+
+    @property
+    def tokens(self) -> List[int]:
+        return list(self._request.generated)
+
+    @property
+    def status(self) -> str:
+        if self.timed_out:
+            return "timeout"
+        return self._request.state.value
+
+    @property
+    def error(self) -> Optional[str]:
+        return "deadline exceeded" if self.timed_out else \
+            self._request.error
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.finished_at is None:
+            return None
+        return self.finished_at - self.submitted_at
+
+
+class ServeFrontend:
+    """Bounded-queue frontend over a :class:`ContinuousBatchingScheduler`.
+
+    ``max_queue`` bounds waiting requests ACROSS frontend + scheduler
+    (running ones don't count — they hold pages, not queue slots).
+    ``clock`` defaults to ``time.monotonic``; tests inject a fake.
+    """
+
+    def __init__(self, scheduler: ContinuousBatchingScheduler,
+                 max_queue: int = 64,
+                 clock: Callable[[], float] = time.monotonic):
+        self.scheduler = scheduler
+        self.max_queue = int(max_queue)
+        self.clock = clock
+        self._handles: Dict[int, RequestHandle] = {}
+        self._next_id = 0
+
+    # -- submission ----------------------------------------------------
+    def queue_depth(self) -> int:
+        return len(self.scheduler.waiting)
+
+    def submit(self, prompt, max_new_tokens: int,
+               sampling: Optional[SamplingParams] = None,
+               stop_token: Optional[int] = None,
+               timeout_s: Optional[float] = None,
+               on_token: Optional[Callable[[int, int], None]] = None,
+               ) -> RequestHandle:
+        """Enqueue one request; raises :class:`QueueFull` when the
+        waiting queue is at ``max_queue``.  ``on_token(request_id,
+        token)`` streams tokens as they are sampled."""
+        if self.queue_depth() >= self.max_queue:
+            raise QueueFull(
+                f"waiting queue at capacity ({self.max_queue})"
+            )
+        rid = self._next_id
+        self._next_id += 1
+        req = Request(
+            request_id=rid,
+            prompt=list(map(int, prompt)),
+            max_new_tokens=int(max_new_tokens),
+            sampling=sampling or SamplingParams(),
+            stop_token=stop_token,
+            on_token=on_token,
+        )
+        handle = RequestHandle(
+            request_id=rid,
+            submitted_at=self.clock(),
+            timeout_s=timeout_s,
+            _request=req,
+        )
+        self._handles[rid] = handle
+        self.scheduler.add_request(req)
+        if req.done:  # rejected at intake (oversized / empty prompt)
+            handle.finished_at = handle.submitted_at
+        return handle
+
+    # -- deadlines -----------------------------------------------------
+    def _expire(self, now: float) -> int:
+        """Cancel every live request past its deadline.  Waiting ones
+        are dropped from the queue; running ones are evicted (pages
+        freed).  Returns how many were cancelled."""
+        expired = [
+            h for h in self._handles.values()
+            if not h.done and h.timeout_s is not None
+            and now - h.submitted_at > h.timeout_s
+        ]
+        for h in expired:
+            req = h._request
+            sched = self.scheduler
+            if req in sched.waiting:
+                sched.waiting.remove(req)
+            if req in sched.running:
+                sched.running.remove(req)
+            if req.request_id in sched.engine.kv:
+                sched.engine.kv.free(req.request_id)
+            req.state = RequestState.FAILED
+            req.error = "deadline exceeded"
+            sched._finished[req.request_id] = req
+            h.timed_out = True
+            h.finished_at = now
+        return len(expired)
+
+    # -- driving -------------------------------------------------------
+    def step(self) -> int:
+        """Expire deadlines, then one scheduler iteration.  Returns
+        tokens emitted."""
+        self._expire(self.clock())
+        emitted = self.scheduler.step()
+        now = self.clock()
+        for h in self._handles.values():
+            if h._request.done and h.finished_at is None:
+                h.finished_at = now
+        self._expire(now)
+        return emitted
+
+    def run_until_idle(self, max_steps: int = 100_000) -> None:
+        steps = 0
+        while self.scheduler.has_work:
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"frontend did not drain within {max_steps} steps"
+                )
+            self.step()
+
+    # -- results -------------------------------------------------------
+    def result(self, handle: RequestHandle,
+               max_steps: int = 100_000) -> List[int]:
+        """Drive the loop until ``handle`` completes; returns its
+        tokens.  Raises on failure/timeout."""
+        steps = 0
+        while not handle.done:
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError("request did not complete")
+            self.step()
+        if handle.status == "timeout":
+            raise TimeoutError(
+                f"request {handle.request_id} exceeded its deadline"
+            )
+        if handle.status == "failed":
+            raise RuntimeError(
+                f"request {handle.request_id} failed: {handle.error}"
+            )
+        return handle.tokens

@@ -1,0 +1,233 @@
+"""Paged KV cache accounting — block tables, alloc/free, defragmentation.
+
+The device-side pages (the ``(n_blocks, block_size, n_kv, d_head)``
+arrays each attention layer reads and writes) live in the serving
+engine's flax ``cache`` collection; THIS class is the host-side memory
+manager that decides which page holds which token — the vLLM
+``BlockAllocator``/block-table split, sized so the whole thing is plain
+deterministic Python:
+
+* one free list (LIFO — O(1), and deterministic so two runs of the same
+  request trace allocate identical physical pages);
+* one block table per live sequence: the ordered page ids covering token
+  positions ``[0, seq_len)``, position ``t`` living in
+  ``table[t // block_size]`` at slot ``t % block_size``;
+* conservation invariants checked on every mutation in
+  :meth:`assert_consistent` — the "leak" the tests pin is a page that is
+  neither free nor reachable from a table.
+
+Eviction is *recomputable* preemption: :meth:`free` returns the pages to
+the pool and the scheduler re-prefixes the sequence (prompt + generated
+so far) through prefill when it is re-admitted — no swap-out copy, the
+standard recompute-beats-copy trade at small sequence lengths.
+
+:meth:`defragment` compacts live pages to the lowest indices (rewriting
+every table) and returns the permutation the engine applies to the
+device pages — after an eviction-heavy burst the live pages are
+scattered, and compaction restores the dense-prefix layout that keeps
+page gathers within a warm slab.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from chainermn_tpu.ops.decode_attention import invalid_block
+
+
+class OutOfBlocks(RuntimeError):
+    """Raised when an allocation cannot be satisfied from the free list.
+    The scheduler catches this and preempts (evicts) a victim sequence."""
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheStats:
+    """Occupancy snapshot — the numbers the Reporter gauges publish."""
+
+    n_blocks: int
+    block_size: int
+    used_blocks: int
+    free_blocks: int
+    n_seqs: int
+    utilization: float  # used / total, in [0, 1]
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class PagedKVCache:
+    """Host-side page accounting for a fixed pool of KV pages.
+
+    ``n_blocks`` pages of ``block_size`` tokens each.  Sequence ids are
+    caller-chosen hashables (the scheduler uses request ids).
+    """
+
+    def __init__(self, n_blocks: int, block_size: int):
+        if n_blocks <= 0 or block_size <= 0:
+            raise ValueError("n_blocks and block_size must be positive")
+        self.n_blocks = int(n_blocks)
+        self.block_size = int(block_size)
+        #: the scatter/gather sentinel for unallocated table slots.
+        self.invalid = invalid_block(self.n_blocks)
+        # LIFO free list, seeded high-to-low so the first allocations
+        # take pages 0, 1, 2, … (the dense-prefix layout defragment
+        # restores).
+        self._free: List[int] = list(range(self.n_blocks - 1, -1, -1))
+        self._tables: Dict[object, List[int]] = {}
+        self._lens: Dict[object, int] = {}
+        #: page moves performed by the most recent :meth:`defragment`.
+        self._last_defrag_moves = 0
+
+    # -- sizing --------------------------------------------------------
+    def blocks_for(self, n_tokens: int) -> int:
+        """Pages needed to hold ``n_tokens`` positions."""
+        return -(-max(0, int(n_tokens)) // self.block_size)
+
+    def can_allocate(self, n_tokens: int, reserve: int = 0) -> bool:
+        """Whether a fresh ``n_tokens``-token sequence fits, keeping
+        ``reserve`` pages untouched (the scheduler's admission watermark:
+        admitting a prompt that leaves zero headroom just converts the
+        next decode iteration into a preemption storm)."""
+        return self.blocks_for(n_tokens) <= len(self._free) - reserve
+
+    # -- alloc/extend/free ---------------------------------------------
+    def allocate(self, seq_id, n_tokens: int) -> List[int]:
+        """Create a sequence covering ``n_tokens`` positions; returns its
+        block table (also readable via :meth:`block_table`)."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already allocated")
+        need = self.blocks_for(n_tokens)
+        if need > len(self._free):
+            raise OutOfBlocks(
+                f"need {need} pages for {n_tokens} tokens, "
+                f"{len(self._free)} free"
+            )
+        table = [self._free.pop() for _ in range(need)]
+        self._tables[seq_id] = table
+        self._lens[seq_id] = int(n_tokens)
+        return list(table)
+
+    def extend(self, seq_id, new_len: int) -> List[int]:
+        """Grow ``seq_id`` to cover ``new_len`` positions; returns the
+        newly allocated page ids (often empty — growth only crosses a
+        page boundary every ``block_size`` tokens)."""
+        table = self._tables[seq_id]
+        need = self.blocks_for(new_len) - len(table)
+        if need > len(self._free):
+            raise OutOfBlocks(
+                f"extending {seq_id!r} to {new_len} tokens needs {need} "
+                f"pages, {len(self._free)} free"
+            )
+        fresh = [self._free.pop() for _ in range(max(0, need))]
+        table.extend(fresh)
+        self._lens[seq_id] = max(self._lens[seq_id], int(new_len))
+        return fresh
+
+    def free(self, seq_id) -> int:
+        """Release every page of ``seq_id``; returns how many."""
+        table = self._tables.pop(seq_id)
+        self._lens.pop(seq_id)
+        self._free.extend(reversed(table))
+        return len(table)
+
+    # -- read side -----------------------------------------------------
+    def __contains__(self, seq_id) -> bool:
+        return seq_id in self._tables
+
+    def seq_ids(self):
+        return list(self._tables)
+
+    def block_table(self, seq_id) -> List[int]:
+        return list(self._tables[seq_id])
+
+    def padded_table(self, seq_id, width: int) -> np.ndarray:
+        """The (width,) int32 device view of a table: real page ids then
+        the invalid sentinel.  ``width`` is the engine's bucketed
+        blocks-per-sequence."""
+        table = self._tables[seq_id]
+        if len(table) > width:
+            raise ValueError(
+                f"table of {seq_id!r} has {len(table)} pages > width "
+                f"{width}"
+            )
+        out = np.full((width,), self.invalid, np.int32)
+        out[: len(table)] = table
+        return out
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.n_blocks - len(self._free)
+
+    def stats(self) -> CacheStats:
+        return CacheStats(
+            n_blocks=self.n_blocks,
+            block_size=self.block_size,
+            used_blocks=self.used_blocks,
+            free_blocks=self.free_blocks,
+            n_seqs=len(self._tables),
+            utilization=self.used_blocks / self.n_blocks,
+        )
+
+    # -- invariants ----------------------------------------------------
+    def assert_consistent(self) -> None:
+        """Conservation check: every page is exactly once either free or
+        in exactly one table, and every table covers its sequence's
+        length.  Cheap enough for tests to call after every operation."""
+        seen = list(self._free)
+        for table in self._tables.values():
+            seen.extend(table)
+        if len(seen) != self.n_blocks or len(set(seen)) != len(seen) or (
+            seen and (min(seen) < 0 or max(seen) >= self.n_blocks)
+        ):
+            raise AssertionError(
+                f"page leak/alias: {len(self._free)} free + "
+                f"{sum(map(len, self._tables.values()))} tabled != "
+                f"{self.n_blocks} total (or duplicate/out-of-range ids)"
+            )
+        for seq_id, table in self._tables.items():
+            if len(table) != self.blocks_for(self._lens[seq_id]):
+                raise AssertionError(
+                    f"table of {seq_id!r} covers {len(table)} pages, "
+                    f"length {self._lens[seq_id]} needs "
+                    f"{self.blocks_for(self._lens[seq_id])}"
+                )
+
+    # -- defragmentation ----------------------------------------------
+    def defragment(self) -> Optional[np.ndarray]:
+        """Compact live pages to indices ``[0, used_blocks)``, preserving
+        per-sequence page order, and rewrite every table in place.
+
+        Returns the (n_blocks,) int32 permutation ``perm`` with
+        ``new_pages[i] = old_pages[perm[i]]`` — the engine applies it to
+        the device pages as ``jnp.take(pages, perm, axis=0)`` — or
+        ``None`` when the layout is already compact (no device copy
+        needed).  Free pages land above the live region in ascending
+        order, so a defragmented cache allocates exactly like a fresh
+        one."""
+        live: List[int] = []
+        for seq_id in sorted(self._tables, key=repr):
+            live.extend(self._tables[seq_id])
+        if live == list(range(len(live))):
+            # Already the dense-prefix layout; just re-seed the free list
+            # so future allocations stay dense.  No device copy.
+            self._free = list(
+                range(self.n_blocks - 1, len(live) - 1, -1)
+            )
+            self._last_defrag_moves = 0
+            return None
+        new_of_old = {old: new for new, old in enumerate(live)}
+        moves = sum(1 for old, new in new_of_old.items() if old != new)
+        leftover = [b for b in range(self.n_blocks) if b not in new_of_old]
+        perm = np.asarray(live + leftover, np.int32)
+        for table in self._tables.values():
+            table[:] = [new_of_old[b] for b in table]
+        self._free = list(range(self.n_blocks - 1, len(live) - 1, -1))
+        self._last_defrag_moves = moves
+        return perm

@@ -1,0 +1,289 @@
+"""Continuous-batching scheduler: iteration-level admission + preemption.
+
+Orca-style scheduling: the unit of work is one *decode iteration*, not
+one request.  Every :meth:`ContinuousBatchingScheduler.step` the
+scheduler (1) admits waiting requests whose prompts fit the cache (FCFS,
+with a free-page watermark so admission doesn't immediately force
+eviction), (2) prefills the newly admitted prompts one at a time, and
+(3) runs ONE batched decode iteration over every running sequence —
+requests join and leave the in-flight batch at iteration granularity, so
+a short request never waits behind a long one's tail.
+
+Preemption is *eviction with recompute*: when the pool can't cover the
+next iteration's page growth, the most-recently-admitted running
+sequence is evicted — its pages freed, its prompt+generated tokens
+pushed back to the FRONT of the waiting queue — and re-prefilled on
+re-admission.  Latest-first victim selection keeps the oldest requests
+making progress (no livelock: the head of the queue is never the
+victim while anything younger runs).  Because sampling is per-request
+counter-based and the paged attention per-sequence, an evicted request
+resumes bit-identically — the parity tests pin exactly that.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from enum import Enum
+from typing import Callable, Deque, Dict, List, Optional
+
+from chainermn_tpu.serving.engine import InferenceEngine, SamplingParams
+from chainermn_tpu.serving.kv_cache import OutOfBlocks
+
+
+class RequestState(Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    FINISHED = "finished"
+    FAILED = "failed"
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request as the scheduler tracks it.
+
+    ``generated`` accumulates sampled tokens; ``state`` moves
+    WAITING → RUNNING (→ WAITING again on preemption) → FINISHED, or
+    FAILED when the request can never be satisfied (prompt alone
+    exceeds the pool).  ``on_token`` fires per sampled token; the
+    frontend plugs streaming callbacks in here.
+    """
+
+    request_id: int
+    prompt: List[int]
+    max_new_tokens: int
+    sampling: SamplingParams = dataclasses.field(
+        default_factory=SamplingParams
+    )
+    stop_token: Optional[int] = None
+    on_token: Optional[Callable[[int, int], None]] = None
+    state: RequestState = RequestState.WAITING
+    generated: List[int] = dataclasses.field(default_factory=list)
+    preemptions: int = 0
+    error: Optional[str] = None
+    #: host step index at which the first token appeared (TTFT proxy).
+    first_token_step: Optional[int] = None
+
+    @property
+    def context(self) -> List[int]:
+        """Prompt + generated so far — what a re-prefill replays."""
+        return list(self.prompt) + list(self.generated)
+
+    @property
+    def done(self) -> bool:
+        return self.state in (RequestState.FINISHED, RequestState.FAILED)
+
+    def _finish_if_complete(self) -> bool:
+        if len(self.generated) >= self.max_new_tokens or (
+            self.stop_token is not None
+            and self.generated
+            and self.generated[-1] == self.stop_token
+        ):
+            self.state = RequestState.FINISHED
+            return True
+        return False
+
+
+class ContinuousBatchingScheduler:
+    """Drives an :class:`InferenceEngine` at iteration granularity.
+
+    ``watermark_blocks`` free pages are kept in reserve at admission
+    time (default: enough for one decode-iteration of page growth at
+    full batch), trading a little admission latency against preemption
+    churn.  ``reporter`` (optional, an observability ``Reporter``)
+    receives occupancy/queue gauges and token counters each step.
+    """
+
+    def __init__(self, engine: InferenceEngine,
+                 watermark_blocks: Optional[int] = None,
+                 reporter=None):
+        self.engine = engine
+        self.watermark = (
+            engine.max_batch if watermark_blocks is None
+            else int(watermark_blocks)
+        )
+        self.reporter = reporter
+        self.waiting: Deque[Request] = deque()
+        self.running: List[Request] = []
+        self._finished: Dict[int, Request] = {}
+        self._step = 0
+
+    # -- intake --------------------------------------------------------
+    def add_request(self, req: Request) -> None:
+        total = len(req.prompt) + req.max_new_tokens
+        if not req.prompt:
+            req.state = RequestState.FAILED
+            req.error = "empty prompt"
+            self._finished[req.request_id] = req
+            return
+        if total > self.engine.config.max_len:
+            req.state = RequestState.FAILED
+            req.error = (
+                f"prompt {len(req.prompt)} + max_new_tokens "
+                f"{req.max_new_tokens} exceeds max_len "
+                f"{self.engine.config.max_len}"
+            )
+            self._finished[req.request_id] = req
+            return
+        self.waiting.append(req)
+
+    # -- policy helpers ------------------------------------------------
+    def _admit(self) -> List[Request]:
+        """FCFS admission until the batch or the cache (minus watermark)
+        is full.  Strict FCFS: stop at the first request that doesn't
+        fit — skipping ahead would starve large prompts."""
+        admitted = []
+        while self.waiting and len(self.running) < self.engine.max_batch:
+            req = self.waiting[0]
+            ctx = len(req.context)
+            # When nothing is running the watermark is waived — a lone
+            # request that fits the bare pool must make progress.
+            reserve = self.watermark if self.running else 0
+            if not self.engine.kv.can_allocate(ctx + 1, reserve=reserve):
+                break
+            self.waiting.popleft()
+            self.engine.kv.allocate(req.request_id, ctx)
+            req.state = RequestState.RUNNING
+            self.running.append(req)
+            admitted.append(req)
+        return admitted
+
+    def _preempt_one(self) -> bool:
+        """Evict the most-recently-admitted running sequence back to the
+        head of the waiting queue.  Returns False when nothing is left
+        to evict."""
+        if not self.running:
+            return False
+        victim = self.running.pop()
+        self.engine.kv.free(victim.request_id)
+        victim.state = RequestState.WAITING
+        victim.preemptions += 1
+        self.waiting.appendleft(victim)
+        if self.reporter is not None:
+            self.reporter.count("serving/preemptions", 1)
+        return True
+
+    def _fail(self, req: Request, msg: str) -> None:
+        if req.request_id in self.engine.kv:
+            self.engine.kv.free(req.request_id)
+        if req in self.running:
+            self.running.remove(req)
+        req.state = RequestState.FAILED
+        req.error = msg
+        self._finished[req.request_id] = req
+
+    def _retire(self, req: Request) -> None:
+        self.engine.kv.free(req.request_id)
+        self.running.remove(req)
+        self._finished[req.request_id] = req
+
+    def _emit(self, req: Request, token: int) -> None:
+        req.generated.append(token)
+        if req.first_token_step is None:
+            req.first_token_step = self._step
+        if req.on_token is not None:
+            req.on_token(req.request_id, token)
+
+    # -- the iteration -------------------------------------------------
+    def step(self) -> int:
+        """One scheduler iteration: admit → prefill admitted → one
+        batched decode over all running sequences.  Returns the number
+        of tokens emitted this step (0 = idle)."""
+        self._step += 1
+        emitted = 0
+
+        for req in self._admit():
+            try:
+                logits = self.engine.prefill(req.context, req.request_id)
+            except ValueError as e:  # oversized prompt and similar
+                self._fail(req, str(e))
+                continue
+            tok = self.engine.sample(
+                logits, req.sampling, len(req.context)
+            )
+            self._emit(req, tok)
+            emitted += 1
+            if req._finish_if_complete():
+                self._retire(req)
+
+        # One decode iteration over the whole running set.  Page growth
+        # (extend) happens first so an OutOfBlocks preempts BEFORE any
+        # cache write — the evicted sequence replays cleanly.
+        while self.running:
+            try:
+                for req in self.running:
+                    self.engine.kv.extend(
+                        req.request_id, len(req.context)
+                    )
+                break
+            except OutOfBlocks:
+                if not self._preempt_one():
+                    break
+                if not self.running:
+                    # the pool can't hold even one sequence's growth
+                    lone = self.waiting.popleft()
+                    self._fail(
+                        lone,
+                        "sequence cannot grow within the cache even "
+                        "when running alone",
+                    )
+        if self.running:
+            batch = list(self.running)
+            # context[-1] is the token sampled last step but not yet
+            # written to the pages — write it at position len-1, then
+            # the returned logits predict position len.
+            lens = [len(r.context) - 1 for r in batch]
+            logits = self.engine.decode(
+                [r.context[-1] for r in batch],
+                [r.request_id for r in batch],
+                lens,
+            )
+            for i, req in enumerate(batch):
+                tok = self.engine.sample(
+                    logits[i], req.sampling, lens[i] + 1
+                )
+                self._emit(req, tok)
+                emitted += 1
+                if req._finish_if_complete():
+                    self._retire(req)
+
+        if self.reporter is not None:
+            st = self.engine.kv.stats()
+            self.reporter.gauge("serving/cache_utilization",
+                                st.utilization)
+            self.reporter.gauge("serving/used_blocks", st.used_blocks)
+            self.reporter.gauge("serving/running", len(self.running))
+            self.reporter.gauge("serving/waiting", len(self.waiting))
+            if emitted:
+                self.reporter.count("serving/tokens", emitted)
+        return emitted
+
+    # -- driving -------------------------------------------------------
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def run_to_completion(self, max_steps: int = 100_000
+                          ) -> Dict[int, Request]:
+        """Step until idle; returns {request_id: Request} for every
+        retired request.  ``max_steps`` is a runaway guard, not a
+        policy knob."""
+        steps = 0
+        while self.has_work:
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"scheduler did not drain within {max_steps} steps"
+                )
+            made = self.step()
+            if made == 0 and not self.running and self.waiting:
+                # waiting but nothing admittable and nothing running:
+                # the head request can never fit.
+                self._fail(
+                    self.waiting.popleft(),
+                    "prompt cannot be admitted: exceeds cache capacity",
+                )
+        return dict(self._finished)
+
+    def results(self) -> Dict[int, Request]:
+        return dict(self._finished)

@@ -204,9 +204,11 @@ def main(argv=None) -> int:
                     help="comma-separated rule ids to suppress")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--fixtures", nargs="*", default=None, metavar="NAME",
-                    help="lint the seeded-violation corpus (all fixtures "
-                         "when no names given); exits nonzero — every "
-                         "fixture is a real violation")
+                    help="lint the fixture corpus (all fixtures when no "
+                         "names given); the full run exits nonzero — the "
+                         "seeded violations must fire, while the clean "
+                         "entries (expect=None, e.g. serving_decode) "
+                         "must stay finding-free")
     ap.add_argument("--communicators", default=None,
                     help="clean-gate backend list (default: all five)")
     ap.add_argument("--entry", action="append", default=[],

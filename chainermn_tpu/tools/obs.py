@@ -115,6 +115,26 @@ def summarize(rows: List[dict], curve_points: int = 16) -> dict:
             "total_s": sum(float(r.get("secs", 0.0)) for r in compiles),
         }
 
+    gauge_rows = [r for r in rows if r.get("event") == "gauge"
+                  and "name" in r]
+    if gauge_rows:
+        # Reporter.gauge semantics: last value wins per (rank, name) in
+        # file order; ranks then merge to sum with min/max spread.
+        last: Dict[tuple, float] = {}
+        for r in gauge_rows:
+            last[(int(r.get("rank", 0)), str(r["name"]))] = \
+                float(r.get("value", 0.0))
+        gauges: Dict[str, dict] = {}
+        for (_, name), v in last.items():
+            d = gauges.setdefault(
+                name, {"sum": 0.0, "min": v, "max": v, "n": 0}
+            )
+            d["sum"] += v
+            d["min"] = min(d["min"], v)
+            d["max"] = max(d["max"], v)
+            d["n"] += 1
+        out["gauges"] = gauges
+
     audits = [r for r in rows if r.get("event") == "hlo_audit"]
     if audits:
         counts: Dict[str, int] = {}
@@ -189,6 +209,16 @@ def to_prometheus(summary: dict, prefix: str = "chainermn_tpu") -> str:
                "Host-side span durations",
                [((("span", k),), v["total_s"])
                 for k, v in sorted(spans.items())])
+    gauges = summary.get("gauges")
+    if gauges:
+        metric("gauge", "gauge",
+               "Set-style gauges, last value per rank summed across ranks",
+               [((("name", k),), v["sum"])
+                for k, v in sorted(gauges.items())])
+        metric("gauge_max", "gauge",
+               "Most-loaded rank's value per set-style gauge",
+               [((("name", k),), v["max"])
+                for k, v in sorted(gauges.items())])
     coll = summary.get("collectives")
     if coll:
         metric("collective_ops_total", "counter",

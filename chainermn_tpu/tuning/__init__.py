@@ -47,14 +47,18 @@ from chainermn_tpu.tuning.search_space import (  # noqa: F401
     bucket_search_space,
     ce_cache_key,
     ce_search_space,
+    decode_cache_key,
+    decode_search_space,
     flash_cache_key,
     flash_search_space,
 )
 from chainermn_tpu.tuning.autotune import (  # noqa: F401
     lookup_bucket_bytes,
     lookup_ce_chunk,
+    lookup_decode_block_ctx,
     lookup_flash_blocks,
     tune_allreduce_bucket,
+    tune_decode_attention,
     tune_flash,
     tune_fused_ce,
     tune_lm_shapes,

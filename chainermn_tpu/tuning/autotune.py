@@ -31,6 +31,8 @@ from chainermn_tpu.tuning.search_space import (
     bucket_search_space,
     ce_cache_key,
     ce_search_space,
+    decode_cache_key,
+    decode_search_space,
     flash_cache_key,
     flash_default_config,
     flash_search_space,
@@ -118,6 +120,26 @@ def lookup_bucket_bytes(*, total_bytes: int, n_leaves: int, dtype,
     except Exception:
         return None
     return bb if bb >= 0 else None
+
+
+def lookup_decode_block_ctx(*, n_pages: int, page_size: int, n_kv: int,
+                            d_head: int, dtype) -> Optional[int]:
+    """Tuned context-gather chunk (in pages) for paged decode attention,
+    or None (one-shot gather) on a miss / off-TPU / under pytest.  The
+    inert-off-TPU guard doubles as the serving engine's determinism
+    guard: CPU decode numerics never depend on the tune cache."""
+    if not runtime_lookup_enabled():
+        return None
+    try:
+        entry = shared_cache().get(decode_cache_key(
+            device_kind(), dtype, n_pages, page_size, n_kv, d_head
+        ))
+        if not entry:
+            return None
+        bc = int(entry["block_ctx"])
+    except Exception:
+        return None
+    return bc if bc >= 1 else None
 
 
 # --------------------------------------------------------------------------
@@ -475,6 +497,99 @@ def tune_allreduce_bucket(
          "n_leaves": n_leaves, "device_size": n},
     )
     rec["kernel"] = "allreduce_bucket"
+    return rec
+
+
+def tune_decode_attention(
+    *,
+    n_pages: int,
+    page_size: int,
+    n_kv: int,
+    d_head: int,
+    n_heads: Optional[int] = None,
+    batch: int = 8,
+    dtype="bfloat16",
+    cache: Optional[TuneCache] = None,
+    n1: int = 3,
+    repeats: int = 3,
+    force: bool = False,
+    dry_run: bool = False,
+    log: Optional[Callable[[str], None]] = None,
+) -> dict:
+    """Tune the paged decode-attention context-gather chunk for one page
+    geometry.  Times :func:`~chainermn_tpu.ops.paged_attention_decode`
+    over a full table (the worst-case context) at each candidate
+    ``block_ctx`` — including 0, the one-shot gather — and persists the
+    argmin under the key the serving engine's trace-time lookup
+    (:func:`lookup_decode_block_ctx`) reads back on TPU.  Chunking is
+    data movement only, so the tuned pick is bit-identical to the
+    default; only the transient-buffer footprint and gather schedule
+    move."""
+    import numpy as np
+
+    space = decode_search_space(n_pages, page_size, n_kv, d_head, dtype,
+                                batch=batch)
+    default_cfg = {"block_ctx": 0}
+    key = decode_cache_key(
+        device_kind(), dtype, n_pages, page_size, n_kv, d_head
+    )
+    if dry_run:
+        return {"kernel": "paged_decode", "dry_run": True, "key": key,
+                "candidates": space, "default": default_cfg}
+    _require_tuning_allowed("paged decode attention")
+    cache = cache or shared_cache()
+    cached = cache.get(key) if not force else None
+    if cached and int(cached.get("block_ctx", -1)) >= 0:
+        return {"kernel": "paged_decode", "key": key, "cached": True,
+                "chosen": {"block_ctx": int(cached["block_ctx"])}}
+
+    from chainermn_tpu.ops.decode_attention import paged_attention_decode
+    from chainermn_tpu.utils.profiling import sync
+
+    H = n_heads or n_kv
+    W = n_pages // max(1, batch)  # pages per sequence, full occupancy
+    rng = np.random.RandomState(0)
+    dt = dtype_name(dtype)
+    q = jax.numpy.asarray(rng.randn(batch, 1, H, d_head), dt)
+    kp = jax.numpy.asarray(
+        rng.randn(n_pages, page_size, n_kv, d_head), dt
+    )
+    vp = jax.numpy.asarray(
+        rng.randn(n_pages, page_size, n_kv, d_head), dt
+    )
+    tables = jax.numpy.asarray(
+        rng.permutation(n_pages)[: batch * W].reshape(batch, W), "int32"
+    )
+    lens = jax.numpy.full((batch,), W * page_size, "int32")
+    if log:
+        log(f"paged_decode {key}: {len(space)} candidates")
+
+    def build(cfg):
+        bc = cfg["block_ctx"] or None
+        f = jax.jit(
+            lambda q, kp, vp, t, sl: paged_attention_decode(
+                q, kp, vp, t, sl, block_ctx=bc
+            )
+        )
+
+        def run(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                o = f(q, kp, vp, tables, lens)
+            sync(o)
+            return time.perf_counter() - t0
+
+        return run
+
+    results = measure_candidates(build, space, n1=n1, repeats=repeats,
+                                 log=log)
+    rec = _finish(
+        key, results, default_cfg, cache,
+        {"kernel": "paged_decode", "dtype": dt, "n_pages": n_pages,
+         "page_size": page_size, "n_kv": n_kv, "d_head": d_head,
+         "batch": batch},
+    )
+    rec["kernel"] = "paged_decode"
     return rec
 
 

@@ -179,6 +179,56 @@ def ce_cache_key(dev_kind: str, dtype, N: int, V: int, D: int) -> str:
     )
 
 
+#: candidate context-gather chunks (in PAGES) for paged decode attention:
+#: how many block-table entries one gather materializes at a time.
+DECODE_BLOCK_CTX_CANDIDATES = (4, 8, 16, 32, 64, 128)
+
+#: cap on the transient gathered (batch, ctx, n_kv, D) K/V buffer a decode
+#: step may materialize per gather chunk (both K and V, double-buffered).
+DECODE_GATHER_BYTES_MAX = 64 * 1024 * 1024
+
+
+def decode_search_space(
+    n_pages: int, page_size: int, n_kv: int, D: int, dtype,
+    batch: int = 8,
+) -> List[dict]:
+    """Valid ``{"block_ctx"}`` candidates for the paged decode-attention
+    gather: chunks of at most the table width whose transient gathered
+    K+V buffer stays bounded.  ``None`` → one-shot gather is the static
+    default and always a member (spelled ``{"block_ctx": 0}``), so a
+    tuned pick can never lose to it."""
+    import numpy as np
+
+    itemsize = np.dtype(dtype).itemsize
+    out = [{"block_ctx": 0}]  # 0 = unchunked (the static default)
+    for bc in DECODE_BLOCK_CTX_CANDIDATES:
+        if bc >= n_pages:
+            break
+        per_chunk = 2 * 2 * batch * bc * page_size * n_kv * D * itemsize
+        if per_chunk > DECODE_GATHER_BYTES_MAX:
+            continue
+        out.append({"block_ctx": bc})
+    return out
+
+
+def decode_cache_key(dev_kind: str, dtype, n_pages: int, page_size: int,
+                     n_kv: int, D: int) -> str:
+    """Cache key for the paged decode-attention gather chunk.  Page count
+    is pow2-bucketed (it only scales the table width); page size, kv-head
+    count and head dim are exact — they set the gathered tile shape.  The
+    decode batch is NOT part of the key: the serving engine rebuckets the
+    batch every iteration, and a per-batch key would fragment the cache
+    across bucket churn for a knob whose optimum tracks the tile shape."""
+    return make_key(
+        "paged_decode",
+        dev_kind,
+        dtype,
+        (("p", bucket_pow2(n_pages)), ("s", page_size), ("h", n_kv),
+         ("d", D)),
+        {},
+    )
+
+
 #: candidate gradient-allreduce bucket caps: the pow2 ladder around the
 #: 4 MiB static default (chainermn_tpu.communicators.packing).
 BUCKET_BYTES_CANDIDATES = tuple((1 << 20) * m for m in (1, 2, 4, 8, 16, 32))

@@ -1,0 +1,559 @@
+"""Serving subsystem: paged KV cache, engine, scheduler, frontend.
+
+The contract under test, in rough order of importance:
+
+1. **Bit-exact batching** — a request's token stream is identical
+   whether it runs alone (``engine.generate``), shares continuous-
+   batched iterations, or is preempted and recomputed mid-flight.
+   Token-id comparisons: greedy argmax over fp32 logits makes them an
+   exact-equality surface.
+2. **Page conservation** — no allocation pattern (including eviction
+   churn and defragmentation) leaks or aliases a page.
+3. **Bounded recompiles** — compiled step count tracks the bucket
+   ladder, not the request count.
+4. **Policy behavior** — FCFS admission, latest-first preemption,
+   queue backpressure, deadline expiry (fake clock: no sleeps).
+5. **Collective-free decode** — the jitted decode step's HLO census is
+   pinned empty in ``tests/golden/serving_decode_census.json``
+   (regen: ``python tests/test_serving.py --regen``).
+
+All CPU; the module-scope LM keeps the suite's jit count low.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.serving import (
+    ContinuousBatchingScheduler,
+    EngineConfig,
+    InferenceEngine,
+    OutOfBlocks,
+    PagedKVCache,
+    QueueFull,
+    Request,
+    SamplingParams,
+    ServeFrontend,
+)
+
+CENSUS_GOLDEN_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "golden", "serving_decode_census.json",
+)
+
+VOCAB = 32
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    return TransformerLM(vocab=VOCAB, d_model=16, n_heads=2, d_ff=32,
+                         n_layers=2, max_len=64)
+
+
+@pytest.fixture(scope="module")
+def lm_params(lm):
+    return lm.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+@pytest.fixture(scope="module")
+def oracle(lm, lm_params):
+    """Naive full-recompute greedy decode on the plain dense model — the
+    reference every cached-KV path must match bit-exactly."""
+
+    def run(prompt, n):
+        toks = list(map(int, prompt))
+        out = []
+        for _ in range(n):
+            logits = lm.apply(lm_params, jnp.asarray([toks], jnp.int32))
+            out.append(int(np.argmax(
+                np.asarray(logits[0, -1], np.float32)
+            )))
+            toks.append(out[-1])
+        return out
+
+    return run
+
+
+def make_engine(lm, lm_params, **over):
+    cfg = dict(block_size=4, n_blocks=64, max_len=64, max_batch=4)
+    cfg.update(over)
+    return InferenceEngine(lm, lm_params, EngineConfig(**cfg))
+
+
+def prompts_for(n, rng_seed=7, lo=3, hi=13):
+    rng = np.random.default_rng(rng_seed)
+    return [
+        [int(t) for t in rng.integers(0, VOCAB, size=int(l))]
+        for l in rng.integers(lo, hi, size=n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# PagedKVCache: accounting invariants
+# ---------------------------------------------------------------------------
+def test_kv_cache_alloc_free_conservation():
+    kv = PagedKVCache(n_blocks=8, block_size=4)
+    t = kv.allocate("a", 9)          # 3 pages
+    assert t == [0, 1, 2] and kv.used_blocks == 3
+    kv.assert_consistent()
+    kv.allocate("b", 4)              # 1 page
+    kv.assert_consistent()
+    assert kv.free("a") == 3
+    kv.assert_consistent()
+    assert kv.used_blocks == 1 and "a" not in kv and "b" in kv
+    with pytest.raises(KeyError):
+        kv.free("a")
+    with pytest.raises(ValueError):
+        kv.allocate("b", 1)          # double-allocate
+    kv.free("b")
+    assert kv.used_blocks == 0 and kv.stats().utilization == 0.0
+
+
+def test_kv_cache_extend_and_out_of_blocks():
+    kv = PagedKVCache(n_blocks=4, block_size=4)
+    kv.allocate("a", 4)
+    assert kv.extend("a", 5) == [1]      # crosses a page boundary
+    assert kv.extend("a", 8) == []       # within the second page
+    kv.assert_consistent()
+    kv.allocate("b", 8)
+    with pytest.raises(OutOfBlocks):
+        kv.extend("a", 9)
+    with pytest.raises(OutOfBlocks):
+        kv.allocate("c", 1)
+    kv.assert_consistent()               # failed ops must not leak
+    assert not kv.can_allocate(1)
+    kv.free("b")
+    assert kv.can_allocate(8) and not kv.can_allocate(8, reserve=1)
+
+
+def test_kv_cache_padded_table_uses_oob_sentinel():
+    kv = PagedKVCache(n_blocks=8, block_size=4)
+    kv.allocate("a", 5)
+    t = kv.padded_table("a", 4)
+    assert t.dtype == np.int32
+    assert list(t) == [0, 1, kv.invalid, kv.invalid]
+    assert kv.invalid == 8               # OOB-high, never negative
+    with pytest.raises(ValueError):
+        kv.padded_table("a", 1)
+
+
+def test_kv_cache_defragment_permutation_semantics():
+    kv = PagedKVCache(n_blocks=8, block_size=4)
+    kv.allocate("a", 8)
+    kv.allocate("b", 8)
+    kv.free("a")                          # holes at pages 0,1
+    pages = np.arange(8)                  # fake device pages: id content
+    old_table = kv.block_table("b")
+    perm = kv.defragment()
+    kv.assert_consistent()
+    new_pages = pages[perm]               # engine: take(pages, perm, 0)
+    # b's data moved with its table: content at the new slots is the old
+    # page ids it occupied before.
+    assert [new_pages[i] for i in kv.block_table("b")] == old_table
+    assert kv.block_table("b") == [0, 1]  # dense prefix
+    # already compact: no device copy, free list reseeded dense
+    assert kv.defragment() is None
+    assert kv.allocate("c", 4) == [2]
+
+
+# ---------------------------------------------------------------------------
+# Engine: cached-KV decode parity, buckets, defrag
+# ---------------------------------------------------------------------------
+def test_engine_greedy_matches_full_recompute_oracle(lm, lm_params,
+                                                     oracle):
+    engine = make_engine(lm, lm_params)
+    for prompt in prompts_for(4):
+        assert engine.generate(prompt, 6) == oracle(prompt, 6)
+    engine.kv.assert_consistent()
+    assert engine.kv.used_blocks == 0    # generate() frees its sequence
+
+
+def test_engine_recompile_count_tracks_buckets(lm, lm_params):
+    engine = make_engine(lm, lm_params)
+    lengths = [3, 5, 9, 12]              # table-width buckets 1, 2, 4, 4
+    rng = np.random.default_rng(0)
+    for L in lengths:
+        engine.generate([int(t) for t in rng.integers(0, VOCAB, L)], 3)
+    st1 = engine.stats()
+    # compiles track buckets touched, never the request count
+    assert 0 < st1["prefill_compiles"] <= 3, st1
+    # the same length profile again (fresh tokens): ZERO new compiles
+    for L in lengths * 2:
+        engine.generate([int(t) for t in rng.integers(0, VOCAB, L)], 3)
+    st2 = engine.stats()
+    assert st2["prefill_compiles"] == st1["prefill_compiles"], (st1, st2)
+    assert st2["decode_compiles"] == st1["decode_compiles"], (st1, st2)
+    # a much longer prompt lands in untouched buckets: compiles grow
+    engine.generate(list(range(30)), 3)
+    assert engine.stats()["prefill_compiles"] > st2["prefill_compiles"]
+    st3 = engine.stats()
+    if "decode_jit_cache_size" in st3:   # cross-check jit's own view
+        assert st3["decode_jit_cache_size"] == st3["decode_compiles"]
+
+
+def test_engine_defragment_mid_stream_keeps_numerics(lm, lm_params,
+                                                     oracle):
+    engine = make_engine(lm, lm_params)
+    prompt = prompts_for(1)[0]
+    want = oracle(prompt, 5)
+    sid = "s"
+    engine.kv.allocate(sid, len(prompt))
+    logits = engine.prefill(prompt, sid)
+    got, cur = [], len(prompt)
+    for step in range(5):
+        nxt = int(np.argmax(logits))
+        got.append(nxt)
+        if step == 4:
+            break
+        engine.kv.extend(sid, cur + 1)
+        if step == 1:
+            # Punch a hole below a live page so compaction has to MOVE
+            # pages — including this sequence's — then decode again:
+            # the stream must not notice.  ("lo"/"hi" take the next two
+            # pages off the LIFO free list; freeing "lo" leaves "hi"
+            # stranded above a hole.)
+            engine.kv.allocate("lo", engine.kv.block_size)
+            engine.kv.allocate("hi", engine.kv.block_size)
+            engine.kv.free("lo")
+            assert engine.defragment() > 0
+            engine.kv.free("hi")
+        logits = engine.decode([nxt], [sid], [cur])[0]
+        cur += 1
+    engine.kv.free(sid)
+    assert got == want
+
+
+def test_sampling_params_validation_and_determinism():
+    with pytest.raises(ValueError):
+        SamplingParams(temperature=-1.0)
+    with pytest.raises(ValueError):
+        SamplingParams(top_k=-1)
+    logits = np.random.default_rng(0).normal(size=VOCAB).astype(
+        np.float32
+    )
+    sp = SamplingParams(temperature=0.8, top_k=5, seed=3)
+    draws = {InferenceEngine.sample(logits, sp, position=7)
+             for _ in range(4)}
+    assert len(draws) == 1               # counter-based: reproducible
+    # top-k truncation: every draw over many positions is a top-k token
+    topk = set(np.argsort(logits)[-5:])
+    for pos in range(50):
+        assert InferenceEngine.sample(logits, sp, pos) in topk
+    # greedy ignores the RNG entirely
+    g = SamplingParams()
+    assert InferenceEngine.sample(logits, g, 0) == int(np.argmax(logits))
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: continuous batching == sequential; preemption; fairness
+# ---------------------------------------------------------------------------
+def test_scheduler_batched_equals_sequential(lm, lm_params, oracle):
+    engine = make_engine(lm, lm_params)
+    sched = ContinuousBatchingScheduler(engine)
+    prompts = prompts_for(6)
+    for i, p in enumerate(prompts):
+        sched.add_request(Request(request_id=i, prompt=p,
+                                  max_new_tokens=6))
+    res = sched.run_to_completion()
+    for i, p in enumerate(prompts):
+        assert res[i].state.value == "finished"
+        assert res[i].generated == oracle(p, 6), f"request {i} diverged"
+    engine.kv.assert_consistent()
+    assert engine.kv.used_blocks == 0
+
+
+def test_scheduler_preemption_recompute_is_bit_exact(lm, lm_params,
+                                                     oracle):
+    # Pool sized to force eviction: 4 requests want ~4 pages each but
+    # only 10 exist.  Everyone must still finish with the exact
+    # unpreempted stream.
+    engine = make_engine(lm, lm_params, n_blocks=10)
+    sched = ContinuousBatchingScheduler(engine, watermark_blocks=0)
+    prompts = prompts_for(4, rng_seed=11)
+    for i, p in enumerate(prompts):
+        sched.add_request(Request(request_id=i, prompt=p,
+                                  max_new_tokens=6))
+    res = sched.run_to_completion()
+    assert sum(r.preemptions for r in res.values()) > 0, (
+        "scenario no longer triggers preemption; shrink the pool"
+    )
+    for i, p in enumerate(prompts):
+        assert res[i].state.value == "finished", res[i].error
+        assert res[i].generated == oracle(p, 6)
+    engine.kv.assert_consistent()
+    assert engine.kv.used_blocks == 0
+
+
+def test_scheduler_admission_is_fcfs(lm, lm_params):
+    # max_batch 2: with 4 waiting requests, the first two admitted must
+    # be the first two submitted, and a request is only admitted after
+    # an earlier one retires.
+    engine = make_engine(lm, lm_params, max_batch=2)
+    sched = ContinuousBatchingScheduler(engine)
+    order = []
+    for i, p in enumerate(prompts_for(4, rng_seed=3)):
+        req = Request(request_id=i, prompt=p, max_new_tokens=4)
+        req.on_token = (
+            lambda rid, tok: order.append(rid) if rid not in order
+            else None
+        )
+        sched.add_request(req)
+    sched.step()
+    assert sorted(r.request_id for r in sched.running) == [0, 1]
+    sched.run_to_completion()
+    assert order == [0, 1, 2, 3]         # first token order = FCFS
+
+
+def test_scheduler_rejects_impossible_requests(lm, lm_params):
+    engine = make_engine(lm, lm_params, n_blocks=2)  # 8-token pool
+    sched = ContinuousBatchingScheduler(engine)
+    sched.add_request(Request(request_id=0, prompt=list(range(30)),
+                              max_new_tokens=50))    # > max_len
+    sched.add_request(Request(request_id=1, prompt=list(range(20)),
+                              max_new_tokens=4))     # > pool
+    sched.add_request(Request(request_id=2, prompt=[], max_new_tokens=4))
+    res = sched.run_to_completion()
+    assert res[0].state.value == "failed" and "max_len" in res[0].error
+    assert res[1].state.value == "failed"
+    assert res[2].state.value == "failed"
+    engine.kv.assert_consistent()
+    assert engine.kv.used_blocks == 0
+
+
+def test_scheduler_publishes_gauges_and_counters(lm, lm_params):
+    from chainermn_tpu.observability import Reporter
+
+    rep = Reporter()
+    engine = make_engine(lm, lm_params)
+    sched = ContinuousBatchingScheduler(engine, reporter=rep)
+    for i, p in enumerate(prompts_for(3)):
+        sched.add_request(Request(request_id=i, prompt=p,
+                                  max_new_tokens=4))
+    sched.step()
+    mid = rep.summary()["gauges"]
+    assert mid["serving/running"]["value"] > 0
+    assert mid["serving/cache_utilization"]["value"] > 0
+    sched.run_to_completion()
+    s = rep.summary()
+    assert s["gauges"]["serving/running"]["value"] == 0   # last wins
+    assert s["counters"]["serving/tokens"] == 12
+
+
+# ---------------------------------------------------------------------------
+# Frontend: backpressure, deadlines, streaming
+# ---------------------------------------------------------------------------
+def test_frontend_backpressure_queue_full(lm, lm_params):
+    fe = ServeFrontend(
+        ContinuousBatchingScheduler(make_engine(lm, lm_params)),
+        max_queue=2,
+    )
+    p = prompts_for(1)[0]
+    fe.submit(p, 4)
+    fe.submit(p, 4)
+    with pytest.raises(QueueFull):
+        fe.submit(p, 4)
+    fe.step()                            # admission drains the queue
+    fe.submit(p, 4)                      # now accepted
+    fe.run_until_idle()
+
+
+def test_frontend_timeout_fake_clock(lm, lm_params, oracle):
+    now = [0.0]
+    fe = ServeFrontend(
+        ContinuousBatchingScheduler(make_engine(lm, lm_params)),
+        clock=lambda: now[0],
+    )
+    prompts = prompts_for(2, rng_seed=5)
+    h_ok = fe.submit(prompts[0], 4)
+    h_to = fe.submit(prompts[1], 40, timeout_s=0.5)
+    fe.step()
+    now[0] = 1.0                         # h_to's deadline passes
+    fe.run_until_idle()
+    assert h_ok.status == "finished"
+    assert h_ok.tokens == oracle(prompts[0], 4)
+    assert h_to.status == "timeout" and h_to.done
+    assert h_to.error == "deadline exceeded"
+    with pytest.raises(TimeoutError):
+        fe.result(h_to)
+    # the evicted sequence's pages were reclaimed
+    fe.scheduler.engine.kv.assert_consistent()
+    assert fe.scheduler.engine.kv.used_blocks == 0
+    assert h_ok.latency_s is not None and h_ok.latency_s >= 0
+
+
+def test_frontend_streaming_matches_final_tokens(lm, lm_params):
+    fe = ServeFrontend(
+        ContinuousBatchingScheduler(make_engine(lm, lm_params)),
+    )
+    streamed = {}
+    handles = [
+        fe.submit(p, 5, on_token=lambda rid, tok:
+                  streamed.setdefault(rid, []).append(tok))
+        for p in prompts_for(3, rng_seed=9)
+    ]
+    fe.run_until_idle()
+    for h in handles:
+        assert h.status == "finished"
+        assert streamed[h.request_id] == h.tokens
+        assert len(h.tokens) == 5
+
+
+def test_frontend_temperature_stream_independent_of_batching(lm,
+                                                             lm_params):
+    """Seeded temperature sampling: the stream must not depend on what
+    else shares the batch — run the same request alone and among
+    neighbors."""
+    sp = SamplingParams(temperature=0.7, top_k=8, seed=42)
+    prompt = prompts_for(1, rng_seed=13)[0]
+
+    def run(extra):
+        fe = ServeFrontend(
+            ContinuousBatchingScheduler(make_engine(lm, lm_params)),
+        )
+        h = fe.submit(prompt, 6, sampling=sp)
+        for q in extra:
+            fe.submit(q, 6, sampling=SamplingParams(temperature=1.3,
+                                                    seed=1))
+        fe.run_until_idle()
+        return h.tokens
+
+    alone = run([])
+    crowded = run(prompts_for(3, rng_seed=17))
+    assert alone == crowded
+
+
+# ---------------------------------------------------------------------------
+# Collective-free decode: pinned HLO census
+# ---------------------------------------------------------------------------
+def _decode_census() -> dict:
+    from chainermn_tpu.analysis.fixtures import fixture_serving_decode
+    from chainermn_tpu.observability import audit_fn
+
+    t = fixture_serving_decode()
+    audit = audit_fn(t["fn"], *t["args"])
+    return {
+        "target": t["target"],
+        "hlo_collectives": audit.census(),
+        "reduction_collectives": audit.reduction_collectives(),
+        "per_axis_operand_bytes": dict(
+            sorted(audit.bytes_per_axis.items())
+        ),
+    }
+
+
+def test_decode_step_collective_census_matches_golden():
+    with open(CENSUS_GOLDEN_PATH) as f:
+        golden = json.load(f)
+    current = _decode_census()
+    assert current == golden, (
+        "decode-step collective census drifted — a psum crept into the "
+        "per-sequence data plane?  If intended (it should not be), "
+        f"regenerate with: python {__file__} --regen"
+    )
+    # the golden itself must pin ZERO collectives (guards a bad regen)
+    assert golden["reduction_collectives"] == 0
+    assert all(v == 0 for v in golden["hlo_collectives"].values())
+    assert golden["per_axis_operand_bytes"] == {}
+
+
+# ---------------------------------------------------------------------------
+# Subprocess smokes: bench --serve, the example
+# ---------------------------------------------------------------------------
+def test_bench_serve_emits_decode_throughput_json():
+    from conftest import subprocess_env
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--serve",
+         "--lm-vocab", "32", "--lm-d-model", "16", "--lm-heads", "2",
+         "--lm-d-ff", "32", "--lm-layers", "1",
+         "--serve-batch-sizes", "1,2", "--serve-requests", "3",
+         "--serve-prompt-len", "6", "--serve-new-tokens", "4",
+         "--serve-block-size", "4", "--serve-blocks", "32",
+         "--serve-max-len", "32"],
+        capture_output=True, text=True, timeout=420,
+        env=subprocess_env(n_devices=1), cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.splitlines()[-1])
+    # same report shape as the train benches: metric/value/unit headline
+    assert out["unit"] == "tokens/sec" and out["value"] > 0
+    assert "decode" in out["metric"]
+    assert [r["batch_size"] for r in out["sweep"]] == [1, 2]
+    for row in out["sweep"]:
+        assert row["finished"] == row["requests"] == 3
+        assert row["tokens_per_sec"] > 0
+        assert row["p50_token_latency_ms"] is not None
+        assert row["p99_token_latency_ms"] >= row["p50_token_latency_ms"]
+
+
+def test_serve_lm_example_smoke():
+    from conftest import subprocess_env
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "examples", "serve_lm", "serve_lm.py"),
+         "--train-steps", "2", "--requests", "3", "--new-tokens", "4",
+         "--n-blocks", "32", "--d-model", "16", "--d-ff", "32"],
+        capture_output=True, text=True, timeout=420,
+        env=subprocess_env(n_devices=1), cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "req 0:" in proc.stdout and "gauges:" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Soak (auto-marked slow by conftest): eviction + defrag churn
+# ---------------------------------------------------------------------------
+def test_serving_soak_eviction_defrag_churn(lm, lm_params, oracle):
+    engine = make_engine(lm, lm_params, n_blocks=12, max_batch=3)
+    sched = ContinuousBatchingScheduler(engine, watermark_blocks=0)
+    fe = ServeFrontend(sched, max_queue=64)
+    prompts = prompts_for(24, rng_seed=23, lo=3, hi=15)
+    handles = [fe.submit(p, 5) for p in prompts]
+    steps = 0
+    while sched.has_work:
+        fe.step()
+        steps += 1
+        if steps % 7 == 0:
+            engine.defragment()          # churn the page layout
+            engine.kv.assert_consistent()
+        assert steps < 10_000
+    for h, p in zip(handles, prompts):
+        assert h.status == "finished", h.error
+        assert h.tokens == oracle(p, 5)
+    engine.kv.assert_consistent()
+    assert engine.kv.used_blocks == 0
+
+
+# ---------------------------------------------------------------------------
+# --regen
+# ---------------------------------------------------------------------------
+def _regen():
+    jax.config.update("jax_platforms", "cpu")
+    census = _decode_census()
+    os.makedirs(os.path.dirname(CENSUS_GOLDEN_PATH), exist_ok=True)
+    with open(CENSUS_GOLDEN_PATH, "w") as f:
+        json.dump(census, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {CENSUS_GOLDEN_PATH}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--regen", action="store_true",
+                    help="regenerate the decode-census golden")
+    if not ap.parse_args().regen:
+        ap.error("run under pytest, or pass --regen to regenerate")
+    _regen()
