@@ -414,6 +414,7 @@ def bench_serve(comm, args):
         ContinuousBatchingScheduler,
         EngineConfig,
         InferenceEngine,
+        QueueFull,
         SamplingParams,
         ServeFrontend,
     )
@@ -436,6 +437,10 @@ def bench_serve(comm, args):
         for _ in range(args.serve_requests)
     ]
     batch_sizes = [int(b) for b in args.serve_batch_sizes.split(",")]
+    if args.serve_queue is None:
+        # default: every synthetic request fits — the sweep measures
+        # decode, not admission backpressure
+        args.serve_queue = len(prompts) + 1
 
     sweep = []
     for bs in batch_sizes:
@@ -447,7 +452,7 @@ def bench_serve(comm, args):
         )
         engine = InferenceEngine(model, params, ecfg)
         sched = ContinuousBatchingScheduler(engine)
-        fe = ServeFrontend(sched, max_queue=len(prompts) + 1)
+        fe = ServeFrontend(sched, max_queue=args.serve_queue)
 
         # warmup: compile the buckets this sweep point will touch
         fe.submit(prompts[0], N, sampling=SamplingParams())
@@ -461,10 +466,18 @@ def bench_serve(comm, args):
         handles = []
         t0 = time.perf_counter()
         for p in prompts:
-            handles.append(
-                fe.submit(p, N, sampling=SamplingParams(),
-                          on_token=on_token)
-            )
+            while True:
+                try:
+                    handles.append(
+                        fe.submit(p, N, sampling=SamplingParams(),
+                                  on_token=on_token)
+                    )
+                    break
+                except QueueFull:
+                    # bounded --serve-queue: drain by stepping (the
+                    # bench IS the only driver; sleeping would just
+                    # stall the engine the hint is waiting on)
+                    fe.step()
         fe.run_until_idle()
         wall = time.perf_counter() - t0
 
@@ -497,7 +510,7 @@ def bench_serve(comm, args):
         })
 
     best = max(sweep, key=lambda r: r["tokens_per_sec"])
-    return {
+    out = {
         "metric": "decode tokens/sec, continuous-batched serving "
                   "(paged KV + jitted decode)",
         "value": best["tokens_per_sec"],
@@ -506,8 +519,133 @@ def bench_serve(comm, args):
         "config": {**cfg, "prompt_len": P, "new_tokens": N,
                    "n_requests": args.serve_requests,
                    "block_size": args.serve_block_size,
-                   "n_blocks": args.serve_blocks},
+                   "n_blocks": args.serve_blocks,
+                   "max_queue": args.serve_queue},
         "sweep": sweep,
+    }
+    if args.serve_replicas > 1:
+        out["cluster"] = bench_serve_cluster(args, model, params)
+    return out
+
+
+def bench_serve_cluster(args, model, params):
+    """Multi-replica tier numbers: routed throughput across
+    ``--serve-replicas`` threaded replicas, plus the disaggregation
+    proof — mixing one long prompt into a stream of short decoders on a
+    single replica stalls their per-token p99 (prefill occupies the
+    engine for whole iterations); splitting the same fleet into a
+    prefill role and a decode role must bring the decoders' p99 back
+    down, because the long prompt never enters the decode replica's
+    step loop until its KV pages migrate over."""
+    from chainermn_tpu.serving import (
+        EngineConfig,
+        InferenceEngine,
+        QueueFull,
+    )
+    from chainermn_tpu.serving.cluster import (
+        Replica,
+        ReplicaRouter,
+        ThreadedClusterDriver,
+    )
+
+    R = args.serve_replicas
+    N = args.serve_new_tokens
+    rng = np.random.RandomState(1)
+    short_prompts = [
+        rng.randint(0, args.lm_vocab, size=args.serve_prompt_len)
+        .tolist()
+        for _ in range(args.serve_requests)
+    ]
+    long_len = min(args.serve_max_len - N - 1,
+                   args.serve_prompt_len * 8)
+    long_prompt = rng.randint(0, args.lm_vocab, size=long_len).tolist()
+
+    def make_engine():
+        return InferenceEngine(model, params, EngineConfig(
+            block_size=args.serve_block_size,
+            n_blocks=args.serve_blocks,
+            max_len=args.serve_max_len,
+            max_batch=max(int(b) for b in
+                          args.serve_batch_sizes.split(",")),
+        ))
+
+    def run_point(roles, prompts, prefill_threshold=None):
+        reps = [
+            Replica(i, make_engine(), role=roles[i],
+                    max_queue=args.serve_queue)
+            for i in range(len(roles))
+        ]
+        router = ReplicaRouter(reps,
+                               prefill_threshold=prefill_threshold)
+        stamps = {}
+
+        def on_token_for(key):
+            def cb(_rid, _tok):
+                stamps.setdefault(key, []).append(time.perf_counter())
+            return cb
+
+        t0 = time.perf_counter()
+        with ThreadedClusterDriver(router) as drv:
+            handles = []
+            for i, p in enumerate(prompts):
+                while True:
+                    try:
+                        handles.append(router.submit(
+                            p, N, on_token=on_token_for(i)))
+                        break
+                    except QueueFull as e:
+                        # bounded-queue backpressure: honor the
+                        # frontend's throughput-derived hint
+                        router.step(drive_replicas=False)
+                        time.sleep(min(e.retry_after_s or 0.01, 0.25))
+            drv.run_until_idle(timeout_s=600)
+        wall = time.perf_counter() - t0
+        total = sum(len(h.tokens) for h in handles)
+        # p99 over SHORT requests only: the long prompt's own latency
+        # is the price of its length; the proof is about bystanders.
+        gaps = []
+        for i, p in enumerate(prompts):
+            if len(p) == long_len and long_len != len(short_prompts[0]):
+                continue
+            ts = stamps.get(i, [])
+            gaps.extend(b - a for a, b in zip(ts, ts[1:]))
+        gaps.sort()
+        p99 = (gaps[min(len(gaps) - 1, int(0.99 * len(gaps)))]
+               if gaps else None)
+        return {
+            "tokens_per_sec": round(total / wall, 1),
+            "finished": sum(1 for h in handles
+                            if h.status == "finished"),
+            "requests": len(handles),
+            "short_p99_token_latency_ms":
+                round(p99 * 1e3, 3) if p99 is not None else None,
+        }
+
+    # Routed throughput: all replicas decode-capable, short traffic.
+    routed = run_point(["both"] * R, short_prompts)
+
+    mixed = [long_prompt] + short_prompts
+    # Baseline: ONE replica takes the long prompt and the decoders.
+    baseline = run_point(["both"], mixed)
+    # Disagg: one prefill-role replica absorbs the long prompt; the
+    # decode fleet never runs its prefill.
+    roles = ["prefill"] + ["decode"] * (R - 1)
+    disagg = run_point(roles, mixed,
+                       prefill_threshold=long_len)
+    proof = None
+    if (baseline["short_p99_token_latency_ms"] is not None
+            and disagg["short_p99_token_latency_ms"] is not None):
+        proof = (disagg["short_p99_token_latency_ms"]
+                 <= baseline["short_p99_token_latency_ms"])
+    return {
+        "replicas": R,
+        "routed": routed,
+        "disagg_proof": {
+            "long_prompt_len": long_len,
+            "single_replica_mixed": baseline,
+            "disaggregated": disagg,
+            "p99_improved_or_equal": proof,
+        },
     }
 
 
@@ -582,6 +720,14 @@ def main(argv=None):
     ap.add_argument("--serve-max-len", type=int, default=512,
                     help="serving max sequence length (prompt + "
                          "generated; also the model max_len)")
+    ap.add_argument("--serve-replicas", type=int, default=1,
+                    help="with --serve: also run the multi-replica "
+                         "tier (threaded replicas behind the router) "
+                         "and the prefill/decode disaggregation p99 "
+                         "proof at this replica count")
+    ap.add_argument("--serve-queue", type=int, default=None,
+                    help="bounded frontend queue size per "
+                         "replica/engine (default: fits all requests)")
     ap.add_argument("--step-log", default=None, metavar="PATH",
                     help="write a JSONL event log of the bench run "
                          "(compile events, instrumented-step spans, the "

@@ -101,6 +101,51 @@ MAX_HEADER_BYTES = 1 << 20
 # malformed frame, so blocked recvs raise instead of hanging to timeout.
 _POISON = object()
 
+
+class PeerGone(RuntimeError):
+    """A host-plane peer died: its connection hit EOF/reset, or a send to
+    it failed at the socket layer.  Distinct from :class:`TimeoutError`
+    (the peer may merely be slow and the recv is retryable): a
+    ``PeerGone`` means the peer's *incarnation* is over — retrying
+    against it is pointless until a replacement re-handshakes (a new
+    process republishing the same rank's endpoint and reconnecting).
+    Router health checks and KV migration catch this to fail over
+    instead of hanging."""
+
+    def __init__(self, msg: str, peer: "int | None" = None):
+        super().__init__(msg)
+        self.peer = peer
+
+
+class _PeerGoneMarker:
+    """Queue sentinel for a dead peer.  Honored only while the plane
+    still believes the peer is gone — a replacement incarnation's first
+    frame revives the peer, after which stale markers are skipped, so
+    messages queued behind one are not lost."""
+
+    __slots__ = ("src", "reason")
+
+    def __init__(self, src: int, reason: str):
+        self.src = src
+        self.reason = reason
+
+
+def retry_backoff(fn, *, retries: int = 3, base_s: float = 0.05,
+                  exceptions=(PeerGone, TimeoutError)):
+    """Call ``fn()`` with exponential backoff on transient host-plane
+    failures (the satellite contract: fail fast with ``PeerGone``/
+    ``TimeoutError``, then retry with backoff rather than hang).  The
+    last failure propagates after ``retries`` re-attempts."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except exceptions:
+            if attempt >= retries:
+                raise
+            time.sleep(base_s * (2 ** attempt))
+            attempt += 1
+
 _pool: ThreadPoolExecutor | None = None
 
 
@@ -387,6 +432,9 @@ class SocketPlane:
         self._queues: dict[tuple, Any] = {}
         self._queues_lock = threading.Lock()
         self._broken: str | None = None  # first reader decode failure
+        # src rank -> reason, for peers whose connection died (EOF/reset).
+        # Cleared when a replacement incarnation's frames arrive.
+        self._gone: dict[int, str] = {}
         self._send_socks: dict[int, Any] = {}
         self._send_lock = threading.Lock()
         self._token = secrets.token_bytes(TOKEN_BYTES)
@@ -397,6 +445,14 @@ class SocketPlane:
         srv.listen(64)
         self._srv = srv
         port = srv.getsockname()[1]
+        # Delete-then-set: a replacement process taking over a dead rank's
+        # identity must be able to republish the endpoint (the KV store
+        # rejects silent overwrites on some backends; delete is idempotent
+        # on others and may raise on a missing key — both are fine).
+        try:
+            client().key_value_delete(f"{_PREFIX}/sockep/{rank}")
+        except Exception:
+            pass
         client().key_value_set(
             f"{_PREFIX}/sockep/{rank}",
             f"{host}:{port}:{self._token.hex()}",
@@ -458,11 +514,39 @@ class SocketPlane:
             got += n
         return True
 
+    def _mark_gone(self, srcs, reason: str) -> None:
+        """Record that every src rank seen on a now-dead connection is
+        gone, and wake any recv blocked on one of its routes with a
+        :class:`_PeerGoneMarker`.  Messages already queued ahead of the
+        marker still deliver in order; the marker is only honored while
+        ``_gone`` still lists the src (a replacement incarnation's first
+        frame revives it, turning queued markers into no-ops)."""
+        if not srcs:
+            return
+        with self._queues_lock:
+            for src in srcs:
+                self._gone[src] = reason
+            routes = [
+                (route, q) for route, q in self._queues.items()
+                if route[1] in srcs
+            ]
+        for (_ns, src, _tag), q in routes:
+            q.put(_PeerGoneMarker(src, reason))
+
+    def peer_gone(self, src: int) -> "str | None":
+        """The recorded death reason for ``src``, or None while it is
+        believed alive."""
+        with self._queues_lock:
+            return self._gone.get(src)
+
     def _reader_loop(self, conn):
         import hmac
         import json as _json
         import struct
 
+        # src ranks whose frames arrived on THIS connection: the set the
+        # connection's death condemns.
+        seen_srcs: set = set()
         try:
             conn.setsockopt(
                 self._socket.IPPROTO_TCP, self._socket.TCP_NODELAY, 1
@@ -479,6 +563,7 @@ class SocketPlane:
             lenbuf = bytearray(4)
             while True:
                 if not self._read_exact(conn, memoryview(lenbuf)):
+                    self._mark_gone(seen_srcs, "connection EOF")
                     return
                 (hlen,) = struct.unpack("<I", lenbuf)
                 if hlen > MAX_HEADER_BYTES:
@@ -488,6 +573,7 @@ class SocketPlane:
                     )
                 hbuf = bytearray(hlen)
                 if not self._read_exact(conn, memoryview(hbuf)):
+                    self._mark_gone(seen_srcs, "connection EOF mid-frame")
                     return
                 hdr = _json.loads(hbuf.decode())
                 nbytes = int(hdr["nbytes"])
@@ -508,17 +594,31 @@ class SocketPlane:
                         )
                     a = np.empty(shape, dt)
                     if not self._read_exact(conn, _byte_view(a)):
+                        self._mark_gone(
+                            seen_srcs, "connection EOF mid-frame"
+                        )
                         return
                     obj = a
                 else:
                     buf = bytearray(nbytes)
                     if not self._read_exact(conn, memoryview(buf)):
+                        self._mark_gone(
+                            seen_srcs, "connection EOF mid-frame"
+                        )
                         return
                     obj = pickle.loads(bytes(buf))
-                route = (hdr["ns"], hdr["src"], hdr["tag"])
+                src = hdr["src"]
+                if src not in seen_srcs:
+                    seen_srcs.add(src)
+                    with self._queues_lock:
+                        # A fresh connection carrying this src's frames
+                        # is the re-handshake: the replacement is live.
+                        self._gone.pop(src, None)
+                route = (hdr["ns"], src, hdr["tag"])
                 self._queue(route).put((hdr["seq"], obj))
-        except OSError:
-            return  # peer died; except-hook territory
+        except OSError as e:
+            self._mark_gone(seen_srcs, f"connection error: {e}")
+            return
         except Exception as e:
             # A malformed frame must not kill the reader silently: record
             # the failure so every pending/future recv raises a transport
@@ -541,33 +641,69 @@ class SocketPlane:
         import queue as _q
 
         q = self._queue((ns, source, tag))
-        if self._broken is not None:
-            raise RuntimeError(
-                f"host-plane socket reader on rank {self.rank} died "
-                f"decoding a frame: {self._broken}"
-            )
-        timeout = None if timeout_ms is None else timeout_ms / 1e3
-        try:
-            item = q.get(timeout=timeout)
-        except _q.Empty:
-            raise TimeoutError(
-                f"recv_obj from {source} tag {tag}: nothing arrived in "
-                f"{timeout_ms} ms"
-            ) from None
-        if item is _POISON:
-            q.put(_POISON)  # keep other waiters on this route failing fast
-            raise RuntimeError(
-                f"host-plane socket reader on rank {self.rank} died "
-                f"decoding a frame: {self._broken}"
-            )
-        got_seq, obj = item
-        if got_seq != seq:
-            raise RuntimeError(
-                f"host-plane stream desync on edge {source}->{self.rank} "
-                f"tag {tag}: expected seq {seq}, got {got_seq} (SPMD "
-                "send/recv order diverged across processes)"
-            )
-        return obj
+        deadline = _deadline_of(timeout_ms)
+        while True:
+            if self._broken is not None:
+                raise RuntimeError(
+                    f"host-plane socket reader on rank {self.rank} died "
+                    f"decoding a frame: {self._broken}"
+                )
+            # Fast-fail on a dead peer with nothing pending: blocking for
+            # the full timeout would be waiting on a corpse.  (Benign
+            # race with q.put in _mark_gone: the marker also wakes us.)
+            reason = self.peer_gone(source)
+            if reason is not None and q.empty():
+                raise PeerGone(
+                    f"host-plane peer {source} is gone ({reason}); recv "
+                    f"on {ns!r} tag {tag} cannot complete until a "
+                    "replacement re-handshakes",
+                    peer=source,
+                )
+            if deadline is None:
+                timeout = None
+            else:
+                timeout = max(1e-3, deadline - time.monotonic())
+            try:
+                item = q.get(timeout=timeout)
+            except _q.Empty:
+                reason = self.peer_gone(source)
+                if reason is not None:
+                    raise PeerGone(
+                        f"host-plane peer {source} is gone ({reason})",
+                        peer=source,
+                    ) from None
+                raise TimeoutError(
+                    f"recv_obj from {source} tag {tag}: nothing arrived "
+                    f"in {timeout_ms} ms"
+                ) from None
+            if item is _POISON:
+                # keep other waiters on this route failing fast
+                q.put(_POISON)
+                raise RuntimeError(
+                    f"host-plane socket reader on rank {self.rank} died "
+                    f"decoding a frame: {self._broken}"
+                )
+            if isinstance(item, _PeerGoneMarker):
+                reason = self.peer_gone(item.src)
+                if reason is None:
+                    # Stale marker: the peer re-handshook after the marker
+                    # was queued.  Drop it and keep draining.
+                    continue
+                q.put(item)  # keep other waiters on this route failing fast
+                raise PeerGone(
+                    f"host-plane peer {item.src} died mid-stream "
+                    f"({item.reason})",
+                    peer=item.src,
+                )
+            got_seq, obj = item
+            if got_seq != seq:
+                raise RuntimeError(
+                    f"host-plane stream desync on edge "
+                    f"{source}->{self.rank} tag {tag}: expected seq "
+                    f"{seq}, got {got_seq} (SPMD send/recv order "
+                    "diverged across processes)"
+                )
+            return obj
 
     # -- send side ------------------------------------------------------
     def _connect(self, dest: int):
@@ -580,11 +716,21 @@ class SocketPlane:
             None,
         )
         host, port, token = ep.rsplit(":", 2)
-        sock = self._socket.create_connection((host, int(port)))
-        sock.setsockopt(
-            self._socket.IPPROTO_TCP, self._socket.TCP_NODELAY, 1
-        )
-        sock.sendall(bytes.fromhex(token))  # handshake (see class doc)
+        try:
+            sock = self._socket.create_connection((host, int(port)))
+            sock.setsockopt(
+                self._socket.IPPROTO_TCP, self._socket.TCP_NODELAY, 1
+            )
+            sock.sendall(bytes.fromhex(token))  # handshake (see class doc)
+        except OSError as e:
+            # The published endpoint no longer answers: the peer died
+            # between publishing and our connect.  A replacement that
+            # republishes the endpoint makes a later attempt succeed.
+            raise PeerGone(
+                f"cannot reach host-plane peer {dest} at {host}:{port} "
+                f"({e})",
+                peer=dest,
+            ) from e
         self._send_socks[dest] = sock
         return sock
 
@@ -615,9 +761,24 @@ class SocketPlane:
         hbytes = _json.dumps(hdr).encode()
         with self._send_lock:
             sock = self._connect(dest)
-            sock.sendall(struct.pack("<I", len(hbytes)))
-            sock.sendall(hbytes)
-            sock.sendall(payload)
+            try:
+                sock.sendall(struct.pack("<I", len(hbytes)))
+                sock.sendall(hbytes)
+                sock.sendall(payload)
+            except OSError as e:
+                # Broken pipe / reset: the peer died under us.  Drop the
+                # cached socket so a retry after the replacement
+                # re-handshakes resolves a fresh endpoint.
+                self._send_socks.pop(dest, None)
+                try:
+                    sock.close()
+                except Exception:
+                    pass
+                raise PeerGone(
+                    f"send to host-plane peer {dest} failed mid-frame "
+                    f"({e}); the frame was NOT delivered",
+                    peer=dest,
+                ) from e
 
 
 _socket_plane: "SocketPlane | None" = None

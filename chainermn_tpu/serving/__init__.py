@@ -18,7 +18,11 @@ exercises the repo's own model (:class:`~chainermn_tpu.models.transformer
   continuous batching: FCFS admission with a free-page watermark, one
   batched decode per step, preemption by eviction with recompute;
 * :mod:`~chainermn_tpu.serving.frontend` — bounded-queue submission
-  with backpressure, per-request deadlines, streaming token callbacks.
+  with backpressure, per-request deadlines, streaming token callbacks;
+* :mod:`~chainermn_tpu.serving.cluster` — the multi-replica tier:
+  load-aware routing, prefill/decode disaggregation, KV-page migration
+  over the host plane, heartbeat failover (see ``docs/serving.md``,
+  "Multi-replica tier").
 
 The load-bearing property, pinned by ``tests/test_serving.py``: a token
 stream is bit-identical whether a request runs alone through

@@ -1,0 +1,50 @@
+"""Multi-replica serving tier: load-aware routing, prefill/decode
+disaggregation, KV-page migration, heartbeats, and failover.
+
+The single-engine stack (engine → scheduler → frontend) is one replica;
+this package turns N of them into a routed fleet:
+
+* :mod:`replica` — one engine+scheduler+frontend unit with a serving
+  *role* (``prefill`` / ``decode`` / ``both``) and a load snapshot;
+* :mod:`router` — load/deadline-aware request placement, failover
+  re-queue from the committed token prefix (bit-exact by the engine's
+  counter-based sampling);
+* :mod:`disagg` — prefill-role replicas run long prompts and hand the
+  finished KV pages to decode-role replicas, so a long prefill never
+  stalls anyone's decode batch;
+* :mod:`migration` — serialize a live sequence's KV pages + block-table
+  slice, move them (in-process or over the typed socket plane), restore
+  with :meth:`PagedKVCache.assert_consistent` holding;
+* :mod:`health` — heartbeat liveness and watermark-driven scale/drain
+  signals as Reporter gauges;
+* :mod:`driver` — threaded per-replica stepping for benchmarks;
+* :mod:`service` — router/replica event loops over the ObjectPlane for
+  real multi-process deployments (``python -m chainermn_tpu.tools.serve``).
+"""
+
+from chainermn_tpu.serving.cluster.disagg import (  # noqa: F401
+    PrefillJob,
+    PrefillResult,
+)
+from chainermn_tpu.serving.cluster.driver import (  # noqa: F401
+    ThreadedClusterDriver,
+)
+from chainermn_tpu.serving.cluster.health import (  # noqa: F401
+    HeartbeatMonitor,
+    scale_signals,
+)
+from chainermn_tpu.serving.cluster.migration import (  # noqa: F401
+    KVSnapshot,
+    extract_sequence,
+    recv_snapshot,
+    restore_sequence,
+    send_snapshot,
+)
+from chainermn_tpu.serving.cluster.replica import (  # noqa: F401
+    Replica,
+    ReplicaLoad,
+)
+from chainermn_tpu.serving.cluster.router import (  # noqa: F401
+    ClusterHandle,
+    ReplicaRouter,
+)

@@ -1,0 +1,129 @@
+"""Replica liveness + watermark-driven autoscaling signals.
+
+Liveness is heartbeat-based: anything that proves a replica executed
+recently counts as a beat — in-process replicas beat on every step;
+remote replicas beat whenever an event batch arrives over the object
+plane (and the plane's ``PeerGone`` short-circuits the wait entirely
+when the TCP connection dies, which is faster than any timeout).
+
+Scaling is *signals, not actions*: :func:`scale_signals` folds the
+fleet's load snapshots into a scale-up flag and a drain candidate,
+published as Reporter gauges (``serving/cluster/*``) for whatever
+actuator watches them — a k8s HPA reading the Prometheus export, a
+notebook calling ``router.drain()``, or nothing.  The policy is the
+standard watermark pair: scale up when free pages or queue slots run
+low fleet-wide, drain the least-loaded replica when the fleet is so
+idle that N-1 replicas could absorb it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Iterable, List, Optional
+
+
+class HeartbeatMonitor:
+    """Deadline-based liveness over caller-supplied beats.
+
+    ``miss_after_s`` without a beat marks a replica dead;
+    :meth:`check` reports NEWLY dead replicas exactly once (the
+    router's failover trigger must not re-fire).  A beat from a dead
+    replica revives it (replacement incarnation)."""
+
+    def __init__(self, replica_ids: Iterable, miss_after_s: float = 2.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.miss_after_s = float(miss_after_s)
+        self.clock = clock
+        now = clock()
+        self._last: Dict[object, float] = {r: now for r in replica_ids}
+        self._dead: set = set()
+
+    def beat(self, replica_id, now: Optional[float] = None) -> None:
+        self._last[replica_id] = self.clock() if now is None else now
+        self._dead.discard(replica_id)
+
+    def mark_dead(self, replica_id) -> None:
+        """Out-of-band death report (e.g. a ``PeerGone`` from the
+        transport) — faster than waiting out the heartbeat deadline."""
+        self._dead.add(replica_id)
+
+    def alive(self, replica_id) -> bool:
+        return replica_id in self._last and replica_id not in self._dead
+
+    def check(self, now: Optional[float] = None) -> List:
+        """Returns replicas that died SINCE the last check."""
+        now = self.clock() if now is None else now
+        newly = [
+            r for r, t in self._last.items()
+            if r not in self._dead and now - t > self.miss_after_s
+        ]
+        self._dead.update(newly)
+        return newly
+
+
+def scale_signals(loads, *, low_free_frac: float = 0.1,
+                  high_free_frac: float = 0.5,
+                  queue_pressure_frac: float = 0.8,
+                  reporter=None) -> dict:
+    """Fold the alive replicas' :class:`ReplicaLoad` snapshots into
+    autoscaling signals.
+
+    * ``scale_up`` — True when fleet-wide free pages sink below
+      ``low_free_frac`` of the pool or any replica's queue passes
+      ``queue_pressure_frac`` of capacity: the moment new requests
+      start paying preemption/backpressure tax.
+    * ``drain_candidate`` — the least-loaded decode-capable replica id
+      when the fleet holds more than ``high_free_frac`` free pages even
+      with that replica removed, queues are empty, and >1 decode
+      replica remains; None otherwise.  Draining (the router stops
+      routing to it; it finishes its streams) is the graceful half of
+      scale-down.
+
+    Gauges published under ``serving/cluster/*`` when ``reporter`` is
+    given.
+    """
+    loads = [ld for ld in loads if ld.alive]
+    decode = [ld for ld in loads if ld.role in ("decode", "both")]
+    total = sum(ld.n_blocks for ld in loads)
+    free = sum(ld.free_blocks for ld in loads)
+    free_frac = free / total if total else 0.0
+    queued = sum(ld.queue_depth for ld in loads)
+    worst_queue = max((ld.queue_frac for ld in loads), default=0.0)
+
+    scale_up = bool(loads) and (
+        free_frac < low_free_frac or worst_queue >= queue_pressure_frac
+    )
+
+    drain_candidate = None
+    if len(decode) > 1 and queued == 0:
+        # Least-loaded: fewest running, then most free pages, then id —
+        # deterministic so repeated checks nominate the same replica.
+        cand = min(
+            decode,
+            key=lambda ld: (ld.running, -ld.free_blocks,
+                            repr(ld.replica_id)),
+        )
+        rest_total = total - cand.n_blocks
+        rest_free = free - cand.free_blocks
+        if (
+            cand.running == 0
+            and rest_total > 0
+            and rest_free / rest_total > high_free_frac
+        ):
+            drain_candidate = cand.replica_id
+
+    out = {
+        "scale_up": scale_up,
+        "drain_candidate": drain_candidate,
+        "free_frac": free_frac,
+        "queued": queued,
+        "replicas_alive": len(loads),
+    }
+    if reporter is not None:
+        reporter.gauge("serving/cluster/scale_up", int(scale_up))
+        reporter.gauge("serving/cluster/drain_pending",
+                       int(drain_candidate is not None))
+        reporter.gauge("serving/cluster/free_frac", free_frac)
+        reporter.gauge("serving/cluster/queued", queued)
+        reporter.gauge("serving/cluster/replicas_alive", len(loads))
+    return out

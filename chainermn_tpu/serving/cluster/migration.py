@@ -1,0 +1,163 @@
+"""KV-page migration: move one live sequence between replicas.
+
+A running sequence's device state is exactly (a) the ordered KV pages
+its block table points at, in every layer's cache leaf, and (b) the
+number of positions they cover.  :func:`extract_sequence` gathers those
+pages (table order, so the physical page ids of the source pool never
+matter) into a host :class:`KVSnapshot`; :func:`restore_sequence`
+allocates a fresh table in the target pool and scatters them in.  The
+two pools may differ in ``n_blocks`` — only the per-sequence slice
+moves — but must agree on ``block_size`` and model geometry (the page
+shape check enforces both).
+
+On the wire (:func:`send_snapshot` / :func:`recv_snapshot`) each leaf's
+pages travel as ONE typed ndarray frame over the ObjectPlane — riding
+the :class:`SocketPlane` raw-buffer fast path, no pickle of bulk data —
+as a flat byte view with dtype/shape in the metadata frame, so exotic
+dtypes (bfloat16) round-trip bit-exactly regardless of numpy's dtype-
+string support for them.
+
+Restores are verified: ``assert_consistent`` runs on the target pool
+before the caller sees the table, and the snapshot carries ``seq_len``
+so an adopted request's context arithmetic is checked at admission
+(:meth:`ContinuousBatchingScheduler.adopt_request`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class KVSnapshot:
+    """Host-side copy of one sequence's live KV state.
+
+    ``pages[i]`` is cache leaf ``i``'s pages in BLOCK-TABLE ORDER with
+    shape ``(n_pages, *page_shape)`` — position ``t`` lives in
+    ``pages[i][t // block_size]`` at slot ``t % block_size``, exactly as
+    on the source device.  ``context`` optionally carries the token ids
+    the pages encode (prompt + generated at extraction time), letting a
+    receiver fall back to re-prefill if restore is impossible."""
+
+    seq_len: int
+    block_size: int
+    pages: List[np.ndarray]
+    context: Optional[List[int]] = None
+
+    @property
+    def n_pages(self) -> int:
+        return self.pages[0].shape[0] if self.pages else 0
+
+    def nbytes(self) -> int:
+        return sum(p.nbytes for p in self.pages)
+
+
+def extract_sequence(engine, seq_id,
+                     context: Optional[List[int]] = None) -> KVSnapshot:
+    """Snapshot ``seq_id``'s pages out of ``engine``'s cache.  The
+    sequence stays live on the source — callers free it (migration) or
+    keep it (replication) afterwards as policy dictates."""
+    kv = engine.kv
+    table = kv.block_table(seq_id)
+    idx = jnp.asarray(np.asarray(table, np.int32))
+    pages = [
+        np.asarray(jnp.take(leaf, idx, axis=0))
+        for leaf in jax.tree_util.tree_leaves(engine._cache)
+    ]
+    return KVSnapshot(
+        seq_len=kv.seq_len(seq_id),
+        block_size=kv.block_size,
+        pages=pages,
+        context=None if context is None else list(map(int, context)),
+    )
+
+
+def restore_sequence(engine, snap: KVSnapshot, seq_id) -> List[int]:
+    """Allocate ``seq_id`` in ``engine``'s pool and scatter the
+    snapshot's pages into its (fresh) block table.  Returns the new
+    table.  Raises ``OutOfBlocks`` (allocation rolled back — nothing
+    was written) when the target pool can't hold the sequence, and
+    ``ValueError`` on any geometry mismatch."""
+    kv = engine.kv
+    if kv.block_size != snap.block_size:
+        raise ValueError(
+            f"block_size mismatch: snapshot pages hold "
+            f"{snap.block_size} tokens, target pool {kv.block_size}"
+        )
+    leaves, treedef = jax.tree_util.tree_flatten(engine._cache)
+    if len(leaves) != len(snap.pages):
+        raise ValueError(
+            f"cache structure mismatch: snapshot has {len(snap.pages)} "
+            f"leaves, target engine {len(leaves)}"
+        )
+    for leaf, p in zip(leaves, snap.pages):
+        if tuple(leaf.shape[1:]) != tuple(p.shape[1:]):
+            raise ValueError(
+                f"page shape mismatch: snapshot {tuple(p.shape[1:])} vs "
+                f"target {tuple(leaf.shape[1:])} (different model "
+                "geometry or block_size?)"
+            )
+    table = kv.allocate(seq_id, snap.seq_len)
+    if len(table) != snap.n_pages:
+        kv.free(seq_id)
+        raise ValueError(
+            f"snapshot of {snap.seq_len} tokens carries {snap.n_pages} "
+            f"pages; target allocated {len(table)}"
+        )
+    idx = jnp.asarray(np.asarray(table, np.int32))
+    engine._cache = jax.tree_util.tree_unflatten(
+        treedef,
+        [
+            leaf.at[idx].set(jnp.asarray(p))
+            for leaf, p in zip(leaves, snap.pages)
+        ],
+    )
+    kv.assert_consistent()
+    return table
+
+
+# -- wire format -------------------------------------------------------
+# One metadata frame (small pickle) then one typed ndarray frame per
+# cache leaf.  Leaves are flattened to raw bytes with (dtype, shape)
+# carried in the metadata: np.ndarray views of uint8 always take the
+# SocketPlane raw-buffer path, and dtype names round-trip through
+# np.dtype() on the receiver (ml_dtypes registers bfloat16 et al. under
+# jax).
+
+def send_snapshot(plane, dest: int, snap: KVSnapshot, tag: int = 7) -> None:
+    """Ship a snapshot to subgroup rank ``dest`` over an ObjectPlane."""
+    meta = {
+        "seq_len": snap.seq_len,
+        "block_size": snap.block_size,
+        "context": snap.context,
+        "leaves": [(str(p.dtype), list(p.shape)) for p in snap.pages],
+    }
+    plane.send(meta, dest, tag=tag)
+    for p in snap.pages:
+        flat = np.ascontiguousarray(p).reshape(-1).view(np.uint8)
+        plane.send(flat, dest, tag=tag)
+
+
+def recv_snapshot(plane, source: int, tag: int = 7,
+                  timeout_ms: Optional[int] = None) -> KVSnapshot:
+    """Receive a :func:`send_snapshot` transmission.  ``timeout_ms``
+    bounds EACH frame's wait; a dead sender surfaces as ``PeerGone`` /
+    ``TimeoutError`` from the plane rather than a hang."""
+    meta = plane.recv(source, tag=tag, timeout_ms=timeout_ms)
+    pages = []
+    for dt_name, shape in meta["leaves"]:
+        flat = plane.recv(source, tag=tag, timeout_ms=timeout_ms)
+        pages.append(
+            np.asarray(flat).view(np.dtype(dt_name)).reshape(shape)
+        )
+    return KVSnapshot(
+        seq_len=int(meta["seq_len"]),
+        block_size=int(meta["block_size"]),
+        pages=pages,
+        context=meta["context"],
+    )

@@ -1,0 +1,489 @@
+"""Load-aware request router over a fleet of serving replicas.
+
+Placement policy, in the order it is applied:
+
+1. **Admissibility** — alive, not draining, decode-capable, waiting
+   queue below capacity, and free pages ≥ the prompt's page need plus
+   the replica's admission watermark (so routing never converts
+   directly into a preemption storm on arrival).
+2. **Deadline slack (SLO routing)** — for requests with a
+   ``timeout_s``: replicas whose estimated queue wait (queued requests
+   ÷ observed per-replica throughput, when warm) exceeds half the
+   request's slack are filtered out, extending the frontend's
+   deadline-aware admission across the fleet.  Cold replicas (no
+   throughput estimate yet) are never filtered.
+3. **Score** — ``2·free_frac − queue_frac − ½·batch_frac``, highest
+   wins, ties broken by replica id: prefer pages first (the resource
+   that converts to preemptions), then shallow queues, then open batch
+   slots.  Deterministic, so tests can pin placements.
+
+Long prompts (≥ ``prefill_threshold``) take the disaggregated path when
+a prefill-capable replica is alive: queued as a :class:`PrefillJob`,
+first token committed at handoff, pages migrated to the best decode
+replica (see :mod:`disagg`).
+
+**Failover** re-queues every live request of a dead replica onto a
+survivor, resubmitting ``prompt`` with the already-streamed tokens as
+the ``committed`` prefix: admission re-prefills prompt+committed and
+sampling continues at the next position with the counter-based RNG, so
+the caller-visible stream (``ClusterHandle.tokens``) is bit-identical
+to an uninterrupted run — no duplicated, dropped, or reordered tokens.
+In-flight prefill jobs and unplaced handoff snapshots from the dead
+replica are re-dispatched the same way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from chainermn_tpu.serving.cluster.disagg import (
+    PrefillJob,
+    PrefillResult,
+    place_handoff,
+)
+from chainermn_tpu.serving.cluster.health import HeartbeatMonitor
+from chainermn_tpu.serving.cluster.replica import Replica, ReplicaLoad
+from chainermn_tpu.serving.engine import SamplingParams
+from chainermn_tpu.serving.frontend import QueueFull
+from chainermn_tpu.serving.scheduler import Request
+
+
+@dataclasses.dataclass
+class ClusterHandle:
+    """Caller-side view of a routed request.  ``tokens`` is the
+    COMMITTED stream — appended exactly once per generated token, in
+    order, across any number of migrations/failovers."""
+
+    request_id: int
+    prompt: List[int]
+    max_new_tokens: int
+    sampling: SamplingParams
+    stop_token: Optional[int]
+    timeout_s: Optional[float]
+    submitted_at: float
+    on_token: Optional[Callable[[int, int], None]] = None
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    status: str = "routed"  # routed|prefill|finished|failed|timeout
+    error: Optional[str] = None
+    replica_id: Optional[object] = None
+    failovers: int = 0
+    #: (replica_id, replica-local request id) of the live placement.
+    _local: Optional[Tuple[object, int]] = None
+
+    @property
+    def done(self) -> bool:
+        return self.status in ("finished", "failed", "timeout")
+
+    def _commit(self, tok: int) -> None:
+        self.tokens.append(tok)
+        if self.on_token is not None:
+            self.on_token(self.request_id, tok)
+
+    def _remaining_timeout(self, now: float) -> Optional[float]:
+        if self.timeout_s is None:
+            return None
+        return self.timeout_s - (now - self.submitted_at)
+
+
+class ReplicaRouter:
+    """Routes requests over ``replicas`` (all sharing model + sampling
+    semantics).  ``prefill_threshold``: prompt length at/above which a
+    request takes the disaggregated path (None → never).  Driving:
+    :meth:`step` (health → place handoffs → step replicas → sync) from
+    one thread, or ``drive_replicas=False`` with a
+    :class:`ThreadedClusterDriver` stepping replicas concurrently."""
+
+    def __init__(self, replicas: List[Replica],
+                 prefill_threshold: Optional[int] = None,
+                 reporter=None,
+                 health: Optional[HeartbeatMonitor] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if not replicas:
+            raise ValueError("a router needs at least one replica")
+        ids = [r.replica_id for r in replicas]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate replica ids: {ids}")
+        self.replicas: Dict[object, Replica] = {
+            r.replica_id: r for r in replicas
+        }
+        self.prefill_threshold = prefill_threshold
+        self.reporter = reporter
+        self.health = health
+        self.clock = clock
+        self._handles: Dict[int, ClusterHandle] = {}
+        #: replica-local id -> cluster handle, per replica.
+        self._by_local: Dict[Tuple[object, int], ClusterHandle] = {}
+        self._pending_handoffs: List[Tuple[PrefillResult,
+                                           ClusterHandle]] = []
+        self._next_gid = 0
+
+    # -- scoring -------------------------------------------------------
+    @staticmethod
+    def score(load: ReplicaLoad) -> float:
+        """Higher is better; see the module docstring for the policy."""
+        return (
+            2.0 * load.free_frac
+            - load.queue_frac
+            - 0.5 * load.batch_frac
+        )
+
+    def _admissible(self, load: ReplicaLoad, need_blocks: int,
+                    watermark: int) -> bool:
+        return (
+            load.alive
+            and not load.draining
+            and load.role in ("decode", "both")
+            and load.queue_depth < load.max_queue
+            and load.free_blocks >= need_blocks + watermark
+        )
+
+    def _est_queue_wait_s(self, load: ReplicaLoad) -> Optional[float]:
+        if load.tokens_per_sec is None or load.tokens_per_sec <= 0:
+            return None
+        # queued requests wait for ~a batch-slot's worth of tokens each;
+        # use the fleet-standard rough cut: queued ÷ (tokens/s).
+        return load.queue_depth / load.tokens_per_sec
+
+    def pick_decode_replica(self, prompt_len: int,
+                            timeout_s: Optional[float] = None,
+                            now: Optional[float] = None
+                            ) -> Optional[Replica]:
+        """The best admissible decode-capable replica for a prompt of
+        ``prompt_len`` tokens, or None when nothing admits it."""
+        now = self.clock() if now is None else now
+        best, best_key = None, None
+        for rep in self.replicas.values():
+            load = rep.load(now)
+            need = rep.engine.kv.blocks_for(prompt_len + 1)
+            if not self._admissible(load, need, rep.scheduler.watermark):
+                continue
+            if timeout_s is not None:
+                wait = self._est_queue_wait_s(load)
+                if wait is not None and wait > 0.5 * timeout_s:
+                    continue
+            key = (self.score(load), repr(rep.replica_id))
+            if best_key is None or key > best_key:
+                best, best_key = rep, key
+        return best
+
+    def _pick_prefill_replica(self) -> Optional[Replica]:
+        best, best_key = None, None
+        for rep in self.replicas.values():
+            if not (rep.alive and rep.can_prefill and not rep.draining):
+                continue
+            # Fewest queued prefills, then most free pages.
+            key = (-rep.pending_prefills,
+                   rep.engine.kv.free_blocks, repr(rep.replica_id))
+            if best_key is None or key > best_key:
+                best, best_key = rep, key
+        return best
+
+    # -- submission ----------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int,
+               sampling: Optional[SamplingParams] = None,
+               stop_token: Optional[int] = None,
+               timeout_s: Optional[float] = None,
+               on_token: Optional[Callable[[int, int], None]] = None,
+               ) -> ClusterHandle:
+        """Route one request; raises :class:`QueueFull` (with the
+        minimum retry-after hint across replicas) when no replica
+        admits it."""
+        gid = self._next_gid
+        self._next_gid += 1
+        handle = ClusterHandle(
+            request_id=gid,
+            prompt=list(map(int, prompt)),
+            max_new_tokens=int(max_new_tokens),
+            sampling=sampling or SamplingParams(),
+            stop_token=stop_token,
+            timeout_s=timeout_s,
+            submitted_at=self.clock(),
+            on_token=on_token,
+        )
+        self._handles[gid] = handle
+        if (
+            self.prefill_threshold is not None
+            and len(handle.prompt) >= self.prefill_threshold
+            and self._pick_prefill_replica() is not None
+        ):
+            self._submit_disagg(handle)
+        else:
+            self._place(handle, committed=[])
+        return handle
+
+    def _submit_disagg(self, handle: ClusterHandle) -> None:
+        rep = self._pick_prefill_replica()
+        job = PrefillJob(
+            handle=handle, prompt=list(handle.prompt),
+            sampling=handle.sampling,
+        )
+        with rep.lock:
+            rep.enqueue_prefill(job)
+        handle.status = "prefill"
+        handle.replica_id = rep.replica_id
+
+    def _place(self, handle: ClusterHandle, committed: List[int]) -> None:
+        """Submit (or re-submit, with a committed prefix) onto the best
+        decode replica."""
+        now = self.clock()
+        rep = self.pick_decode_replica(
+            len(handle.prompt) + len(committed),
+            timeout_s=handle._remaining_timeout(now), now=now,
+        )
+        if rep is None:
+            self._handles.pop(handle.request_id, None)
+            hints = [
+                r.frontend._retry_after_hint()
+                for r in self.replicas.values() if r.alive
+            ]
+            hints = [h for h in hints if h is not None]
+            hint = min(hints) if hints else None
+            msg = "no replica admits this request"
+            if hint is not None:
+                msg += f"; retry after ~{hint:.3f}s"
+            raise QueueFull(msg, retry_after_s=hint)
+        with rep.lock:
+            local = rep.frontend.submit(
+                handle.prompt, handle.max_new_tokens,
+                sampling=handle.sampling, stop_token=handle.stop_token,
+                timeout_s=handle._remaining_timeout(now),
+                on_token=lambda _rid, tok: handle._commit(tok),
+                committed=committed,
+            )
+        handle.status = "routed"
+        handle.replica_id = rep.replica_id
+        handle._local = (rep.replica_id, local.request_id)
+        self._by_local[handle._local] = handle
+
+    # -- handoff placement ---------------------------------------------
+    def _collect_handoffs(self) -> None:
+        for rep in self.replicas.values():
+            if not rep.alive:
+                continue
+            with rep.lock:
+                results = []
+                while rep.handoffs:
+                    results.append(rep.handoffs.popleft())
+            for res in results:
+                handle: ClusterHandle = res.job.handle
+                if res.error is not None:
+                    handle.status = "failed"
+                    handle.error = res.error
+                    continue
+                self._pending_handoffs.append((res, handle))
+
+    def _place_handoffs(self) -> None:
+        still = []
+        for res, handle in self._pending_handoffs:
+            if handle.done:
+                continue  # timed out while pending
+            placed = self._try_place_handoff(res, handle)
+            if not placed:
+                still.append((res, handle))
+        self._pending_handoffs = still
+
+    def _try_place_handoff(self, res: PrefillResult,
+                           handle: ClusterHandle) -> bool:
+        if not handle.tokens:
+            # First token was sampled by the prefill replica; commit it
+            # exactly once, at handoff (stream order is preserved: the
+            # request isn't decoding anywhere yet).
+            handle._commit(res.first_token)
+            if (
+                len(handle.tokens) >= handle.max_new_tokens
+                or res.first_token == handle.stop_token
+            ):
+                handle.status = "finished"
+                return True
+        now = self.clock()
+        rep = self.pick_decode_replica(
+            len(handle.prompt) + len(handle.tokens),
+            timeout_s=handle._remaining_timeout(now), now=now,
+        )
+        if rep is None:
+            return False
+        req = Request(
+            request_id=None,
+            prompt=list(handle.prompt),
+            max_new_tokens=handle.max_new_tokens,
+            sampling=handle.sampling,
+            stop_token=handle.stop_token,
+            on_token=lambda _rid, tok: handle._commit(tok),
+        )
+        req.generated = list(handle.tokens)
+        with rep.lock:
+            local = place_handoff(
+                rep, res, req,
+                timeout_s=handle._remaining_timeout(now),
+            )
+        if local is None:
+            return False
+        handle.status = "routed"
+        handle.replica_id = rep.replica_id
+        handle._local = (rep.replica_id, local.request_id)
+        self._by_local[handle._local] = handle
+        return True
+
+    # -- failover ------------------------------------------------------
+    def fail_replica(self, replica_id, reason: str = "unknown") -> int:
+        """Declare ``replica_id`` dead and re-queue its live work onto
+        survivors.  Returns how many requests were re-queued.  Safe to
+        call twice (second call finds nothing live there)."""
+        rep = self.replicas.get(replica_id)
+        if rep is None:
+            return 0
+        # Take the victim's lock FIRST: an in-flight step (threaded
+        # driving) may still commit tokens to handles placed there.
+        # Once we hold the lock the step has finished, its commits have
+        # landed, and ``alive=False`` stops any further stepping — the
+        # committed prefix we replay below is final, so survivors never
+        # regenerate a token the victim already streamed.
+        with rep.lock:
+            rep.alive = False
+            jobs = list(rep._prefill_jobs)
+            rep._prefill_jobs.clear()
+            results = list(rep.handoffs)
+            rep.handoffs.clear()
+        if self.health is not None:
+            self.health.mark_dead(replica_id)
+        moved = 0
+        # 1. Streaming requests placed on the dead replica: re-place
+        #    with their committed prefix.
+        for (rid, lid), handle in list(self._by_local.items()):
+            if rid != replica_id or handle.done:
+                continue
+            del self._by_local[(rid, lid)]
+            handle.failovers += 1
+            self._requeue(handle, reason)
+            moved += 1
+        # 2. Prefill jobs queued (not yet run) on it: re-dispatch.
+        for job in jobs:
+            handle = job.handle
+            if not handle.done:
+                handle.failovers += 1
+                self._requeue(handle, reason)
+                moved += 1
+        # 3. Completed handoff snapshots it produced remain valid (host
+        #    memory, device-independent) — keep them pending.
+        for res in results:
+            if res.error is None and not res.job.handle.done:
+                self._pending_handoffs.append((res, res.job.handle))
+        return moved
+
+    def _requeue(self, handle: ClusterHandle, reason: str) -> None:
+        try:
+            self._place(handle, committed=list(handle.tokens))
+        except QueueFull as e:
+            handle.status = "failed"
+            handle.error = (
+                f"replica {handle.replica_id!r} died ({reason}) and no "
+                f"survivor admits the request: {e}"
+            )
+        else:
+            self._handles[handle.request_id] = handle
+
+    # -- driving -------------------------------------------------------
+    def step(self, drive_replicas: bool = True) -> int:
+        """One router iteration.  Returns tokens emitted fleet-wide
+        (only meaningful when ``drive_replicas``)."""
+        now = self.clock()
+        if self.health is not None:
+            for rid in self.health.check(now):
+                self.fail_replica(rid, reason="missed heartbeats")
+        emitted = 0
+        if drive_replicas:
+            for rep in self.replicas.values():
+                if not rep.alive:
+                    continue
+                with rep.lock:
+                    emitted += rep.step()
+                if self.health is not None:
+                    self.health.beat(rep.replica_id, now)
+        self._collect_handoffs()
+        self._place_handoffs()
+        self._sync(now)
+        if self.reporter is not None:
+            self.reporter.gauge(
+                "serving/cluster/replicas_alive",
+                sum(r.alive for r in self.replicas.values()),
+            )
+            self.reporter.gauge(
+                "serving/cluster/pending_handoffs",
+                len(self._pending_handoffs),
+            )
+        return emitted
+
+    def _sync(self, now: float) -> None:
+        """Propagate replica-local completion/failure/timeouts to
+        cluster handles, and expire cluster-level deadlines for work not
+        currently placed anywhere (pending handoffs, prefill queue)."""
+        for handle in self._handles.values():
+            if handle.done:
+                continue
+            if handle._local is not None:
+                rid, lid = handle._local
+                rep = self.replicas.get(rid)
+                if rep is None or not rep.alive:
+                    continue  # failover path owns it
+                local = rep.frontend._handles.get(lid)
+                if local is None or not local.done:
+                    continue
+                handle.status = local.status
+                handle.error = local.error
+                self._by_local.pop(handle._local, None)
+                handle._local = None
+            elif (
+                handle.timeout_s is not None
+                and now - handle.submitted_at > handle.timeout_s
+            ):
+                handle.status = "timeout"
+                handle.error = "deadline exceeded"
+
+    @property
+    def has_work(self) -> bool:
+        return (
+            any(not h.done for h in self._handles.values())
+            or bool(self._pending_handoffs)
+        )
+
+    def run_until_idle(self, max_steps: int = 100_000) -> None:
+        steps = 0
+        while self.has_work:
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"router did not drain within {max_steps} steps"
+                )
+            self.step()
+
+    def result(self, handle: ClusterHandle,
+               max_steps: int = 100_000) -> List[int]:
+        """Drive until ``handle`` completes; returns its tokens.
+        Raises on failure/timeout (mirrors ``ServeFrontend.result``)."""
+        steps = 0
+        while not handle.done:
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError("request did not complete")
+            self.step()
+        if handle.status == "timeout":
+            raise TimeoutError(
+                f"request {handle.request_id} exceeded its deadline"
+            )
+        if handle.status == "failed":
+            raise RuntimeError(
+                f"request {handle.request_id} failed: {handle.error}"
+            )
+        return list(handle.tokens)
+
+    # -- drain / scale-down --------------------------------------------
+    def drain(self, replica_id) -> None:
+        """Stop routing NEW work to ``replica_id``; its in-flight
+        streams finish normally.  The graceful half of scale-down."""
+        self.replicas[replica_id].draining = True
+
+    def loads(self, now: Optional[float] = None) -> List[ReplicaLoad]:
+        now = self.clock() if now is None else now
+        return [r.load(now) for r in self.replicas.values()]

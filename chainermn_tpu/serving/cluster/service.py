@@ -1,0 +1,514 @@
+"""Multi-process serving tier over the host object plane.
+
+One process per role: global rank 0 runs :func:`run_router`, every
+other rank :func:`run_replica`.  All control traffic rides ONE
+:class:`ObjectPlane` (namespace ``"serve"``); bulk KV snapshots ride
+replica-to-replica p2p on the same plane (the typed SocketPlane path).
+
+Wire protocol (all p2p, per-edge ordered by the plane's seq matching):
+
+=====================  =============================================
+router → replica (tag CMD)
+---------------------------------------------------------------------
+``{"op": "submit"}``    place a request: gid, prompt, max_new_tokens,
+                        sampling, stop_token, committed (failover
+                        replay prefix), timeout_s
+``{"op": "prefill"}``   disaggregated prompt: gid, prompt, sampling
+``{"op": "send_snapshot"}``  ship gid's finished prefill snapshot to
+                        global rank ``dest`` (tag SNAP)
+``{"op": "recv_snapshot"}``  receive gid's snapshot from global rank
+                        ``source`` and adopt the request
+``{"op": "stop"}``      drain nothing, exit the loop
+---------------------------------------------------------------------
+replica → router (tag EVT) — a LIST of events per loop iteration
+(sent at least every ``heartbeat_s`` even when empty: the batch IS the
+heartbeat)
+---------------------------------------------------------------------
+``("tok", gid, token)``           one streamed token, in order
+``("done", gid, status, error)``  request left the replica
+``("reject", gid, retry_after)``  queue full at submit (router
+                                  re-places elsewhere)
+``("handoff_ready", gid, tok)``   prefill finished; first token
+``("handoff_failed", gid, err)``  prefill/adopt failed terminally
+``("adopted", gid)``              snapshot restored + request adopted
+``("load", load_dict)``           ReplicaLoad.as_dict() snapshot
+=====================  =============================================
+
+Death handling: the router treats a ``PeerGone`` from any recv/send on
+a replica's edge — or ``miss_after_s`` without an event batch — as that
+replica's death, and re-places its live requests on survivors with
+their committed token prefix (bit-exact resume, same as the in-process
+router).  Replicas symmetrically exit if the router's edge dies.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from chainermn_tpu.communicators.kvtransport import ObjectPlane, PeerGone
+from chainermn_tpu.serving.cluster.health import HeartbeatMonitor
+from chainermn_tpu.serving.cluster.replica import Replica, ReplicaLoad
+from chainermn_tpu.serving.cluster.router import ReplicaRouter
+from chainermn_tpu.serving.engine import SamplingParams
+from chainermn_tpu.serving.frontend import QueueFull
+
+CMD = 1
+EVT = 2
+SNAP = 7
+
+#: recv poll slice for the event loops (ms) — short enough to interleave
+#: stepping with message handling, long enough not to spin.
+POLL_MS = 2
+
+
+def _mk_plane(rank: int, size: int) -> ObjectPlane:
+    return ObjectPlane("serve", rank, size, site="serving-cluster")
+
+
+# ---------------------------------------------------------------------
+# replica side
+# ---------------------------------------------------------------------
+
+def run_replica(rank: int, size: int, engine_factory,
+                role: str = "both",
+                max_queue: int = 64,
+                watermark_blocks: Optional[int] = None,
+                heartbeat_s: float = 0.2,
+                kill_after_tokens: Optional[int] = None,
+                plane: Optional[ObjectPlane] = None) -> dict:
+    """Serve as replica ``rank`` until the router says stop (or the
+    router's edge dies).  ``engine_factory()`` builds the
+    InferenceEngine (model + params + config) — construction is the
+    caller's business, the loop is ours.  ``kill_after_tokens`` is the
+    soak-test hook: SIGKILL THIS process after streaming that many
+    tokens (mid-stream, no cleanup — simulating a crashed host)."""
+    import os
+    import signal
+
+    plane = plane or _mk_plane(rank, size)
+    # Announce liveness BEFORE paying engine construction: the first
+    # jit compiles can dwarf the router's heartbeat budget, and an
+    # empty event batch is a valid beat.
+    try:
+        plane.send([], 0, tag=EVT)
+    except PeerGone:
+        return {"streamed": 0, "reason": "router gone"}
+    rep = Replica(
+        rank, engine_factory(), role=role,
+        watermark_blocks=watermark_blocks, max_queue=max_queue,
+    )
+    outbox: List[tuple] = []
+    gid_of_local: Dict[int, int] = {}
+    snapshots: Dict[int, object] = {}  # gid -> finished PrefillResult
+    reported_done: set = set()
+    streamed = 0
+    last_evt = 0.0
+
+    def on_token_for(gid: int):
+        def cb(_local_rid, tok):
+            nonlocal streamed
+            outbox.append(("tok", gid, int(tok)))
+            streamed += 1
+        return cb
+
+    def handle_cmd(msg: dict) -> bool:
+        gid = msg.get("gid")
+        if msg["op"] == "stop":
+            return False
+        if msg["op"] == "submit":
+            sp = SamplingParams(**msg["sampling"])
+            try:
+                h = rep.frontend.submit(
+                    msg["prompt"], msg["max_new_tokens"], sampling=sp,
+                    stop_token=msg["stop_token"],
+                    timeout_s=msg["timeout_s"],
+                    on_token=on_token_for(gid),
+                    committed=msg["committed"],
+                )
+            except QueueFull as e:
+                outbox.append(("reject", gid, e.retry_after_s))
+            else:
+                gid_of_local[h.request_id] = gid
+        elif msg["op"] == "prefill":
+            from chainermn_tpu.serving.cluster.disagg import PrefillJob
+
+            rep.enqueue_prefill(PrefillJob(
+                handle=gid, prompt=msg["prompt"],
+                sampling=SamplingParams(**msg["sampling"]),
+            ))
+        elif msg["op"] == "send_snapshot":
+            from chainermn_tpu.serving.cluster.migration import (
+                send_snapshot,
+            )
+
+            res = snapshots.pop(gid)
+            dest = msg["dest"]
+            try:
+                send_snapshot(
+                    plane, plane.members.index(dest), res.snapshot,
+                    tag=SNAP,
+                )
+            except PeerGone:
+                pass  # the router will see dest's death and requeue
+        elif msg["op"] == "recv_snapshot":
+            from chainermn_tpu.serving.cluster.migration import (
+                recv_snapshot,
+                restore_sequence,
+            )
+            from chainermn_tpu.serving.scheduler import Request
+
+            try:
+                snap = recv_snapshot(
+                    plane, plane.members.index(msg["source"]),
+                    tag=SNAP, timeout_ms=30_000,
+                )
+                rid = rep.frontend.reserve_id()
+                restore_sequence(rep.engine, snap, rid)
+                req = Request(
+                    request_id=rid,
+                    prompt=list(msg["prompt"]),
+                    max_new_tokens=msg["max_new_tokens"],
+                    sampling=SamplingParams(**msg["sampling"]),
+                    stop_token=msg["stop_token"],
+                    on_token=on_token_for(gid),
+                )
+                req.generated = list(msg["committed"])
+                rep.frontend.adopt(req, timeout_s=msg["timeout_s"])
+            except (PeerGone, TimeoutError, ValueError) as e:
+                outbox.append(("handoff_failed", gid, str(e)))
+            else:
+                gid_of_local[rid] = gid
+                outbox.append(("adopted", gid))
+        return True
+
+    running = True
+    while running:
+        # Drain pending commands (tiny poll: stepping must not starve).
+        while True:
+            try:
+                msg = plane.recv(0, tag=CMD, timeout_ms=POLL_MS)
+            except TimeoutError:
+                break
+            except PeerGone:
+                return {"streamed": streamed, "reason": "router gone"}
+            if not handle_cmd(msg):
+                running = False
+                break
+        rep.step()
+        # Finished prefills: announce, park the snapshot for migration.
+        while rep.handoffs:
+            res = rep.handoffs.popleft()
+            gid = res.job.handle
+            if res.error is not None:
+                outbox.append(("handoff_failed", gid, res.error))
+            else:
+                snapshots[gid] = res
+                outbox.append(
+                    ("handoff_ready", gid, int(res.first_token))
+                )
+        # Completions.
+        for h in list(rep.frontend._handles.values()):
+            gid = gid_of_local.get(h.request_id)
+            if gid is None or gid in reported_done:
+                continue
+            if h.done:
+                reported_done.add(gid)
+                outbox.append(("done", gid, h.status, h.error))
+        if (
+            kill_after_tokens is not None
+            and streamed >= kill_after_tokens
+        ):
+            # Crash simulation: die NOW, mid-stream, with tokens queued
+            # and sequences live.  No flush, no cleanup.
+            os.kill(os.getpid(), signal.SIGKILL)
+        now = time.monotonic()
+        if outbox or now - last_evt > heartbeat_s:
+            batch = outbox + [("load", rep.load().as_dict())]
+            outbox = []
+            try:
+                plane.send(batch, 0, tag=EVT)
+            except PeerGone:
+                return {"streamed": streamed, "reason": "router gone"}
+            last_evt = now
+        if not rep.has_work:
+            time.sleep(0.002)
+    try:
+        plane.send([("load", rep.load().as_dict())], 0, tag=EVT)
+    except PeerGone:
+        pass
+    # A clean stop must leave the page pool coherent — failovers and
+    # adoptions this replica absorbed included.
+    rep.engine.kv.assert_consistent()
+    return {"streamed": streamed, "reason": "stopped"}
+
+
+# ---------------------------------------------------------------------
+# router side
+# ---------------------------------------------------------------------
+
+class _RemoteRequest:
+    """Router-side record of one request's life in the remote fleet."""
+
+    def __init__(self, gid: int, spec: dict):
+        self.gid = gid
+        self.spec = spec
+        self.tokens: List[int] = []
+        self.status = "pending"
+        self.error: Optional[str] = None
+        self.replica: Optional[int] = None  # subgroup rank
+        self.failovers = 0
+
+    @property
+    def done(self) -> bool:
+        return self.status in ("finished", "failed", "timeout")
+
+
+def run_router(size: int, requests: List[dict],
+               prefill_threshold: Optional[int] = None,
+               roles: Optional[Dict[int, str]] = None,
+               miss_after_s: float = 3.0,
+               timeout_s: float = 300.0,
+               reporter=None,
+               plane: Optional[ObjectPlane] = None) -> Dict[int, dict]:
+    """Drive ``requests`` (dicts: prompt, max_new_tokens, optional
+    sampling/stop_token/timeout_s) to completion over replicas at
+    subgroup ranks ``1..size-1``.  Returns ``{gid: {"tokens": [...],
+    "status": ..., "error": ..., "failovers": n}}`` with token streams
+    exactly as a single sequential engine would produce them."""
+    plane = plane or _mk_plane(0, size)
+    replica_ranks = list(range(1, size))
+    alive = set(replica_ranks)
+    # Role map is declared up-front (the launcher knows what it started)
+    # and refined by load reports as replicas phone home.
+    roles = {r: "both" for r in replica_ranks} | dict(roles or {})
+    loads: Dict[int, ReplicaLoad] = {}
+    assigned: Dict[int, set] = {r: set() for r in replica_ranks}
+    health = HeartbeatMonitor(replica_ranks, miss_after_s=miss_after_s)
+    reqs: Dict[int, _RemoteRequest] = {}
+    pending: List[_RemoteRequest] = []
+    prefilling: Dict[int, int] = {}  # gid -> prefill replica
+    migrating: Dict[int, tuple] = {}  # gid -> (src, dest)
+
+    for gid, spec in enumerate(requests):
+        spec = dict(spec)
+        spec.setdefault("sampling", {})
+        spec.setdefault("stop_token", None)
+        spec.setdefault("timeout_s", None)
+        rr = _RemoteRequest(gid, spec)
+        reqs[gid] = rr
+        pending.append(rr)
+
+    def send_cmd(rank: int, msg: dict) -> bool:
+        try:
+            plane.send(msg, rank, tag=CMD)
+            return True
+        except PeerGone:
+            on_dead(rank, "send failed: peer gone")
+            return False
+
+    def pick_replica(rr: _RemoteRequest) -> Optional[int]:
+        best, best_key = None, None
+        for r in sorted(alive):
+            if roles.get(r) == "prefill":
+                continue
+            ld = loads.get(r)
+            if ld is not None:
+                if ld.queue_depth >= ld.max_queue:
+                    continue
+                key = (ReplicaRouter.score(ld), -r)
+            else:
+                key = (0.0, -r)  # cold replica: neutral score
+            if best_key is None or key > best_key:
+                best, best_key = r, key
+        return best
+
+    def place(rr: _RemoteRequest) -> bool:
+        r = pick_replica(rr)
+        if r is None:
+            return False
+        ok = send_cmd(r, {
+            "op": "submit", "gid": rr.gid,
+            "prompt": list(rr.spec["prompt"]),
+            "max_new_tokens": rr.spec["max_new_tokens"],
+            "sampling": rr.spec["sampling"],
+            "stop_token": rr.spec["stop_token"],
+            "timeout_s": rr.spec["timeout_s"],
+            "committed": list(rr.tokens),
+        })
+        if ok:
+            rr.replica = r
+            rr.status = "routed"
+            assigned[r].add(rr.gid)
+        return ok
+
+    def on_dead(rank: int, why: str) -> None:
+        if rank not in alive:
+            return
+        alive.discard(rank)
+        health.mark_dead(rank)
+        for gid in sorted(assigned.pop(rank, set())):
+            rr = reqs[gid]
+            if rr.done:
+                continue
+            rr.failovers += 1
+            rr.status = "pending"
+            rr.replica = None
+            pending.append(rr)
+        for gid, pr in list(prefilling.items()):
+            if pr == rank:
+                del prefilling[gid]
+                rr = reqs[gid]
+                if not rr.done:
+                    rr.failovers += 1
+                    rr.status = "pending"
+                    pending.append(rr)
+        for gid, (src, dest) in list(migrating.items()):
+            if rank in (src, dest):
+                del migrating[gid]
+                rr = reqs[gid]
+                if not rr.done:
+                    rr.failovers += 1
+                    rr.status = "pending"
+                    pending.append(rr)
+
+    def handle_evt(rank: int, events: list) -> None:
+        health.beat(rank)
+        for ev in events:
+            kind = ev[0]
+            if kind == "tok":
+                _, gid, tok = ev
+                reqs[gid].tokens.append(int(tok))
+            elif kind == "done":
+                _, gid, status, error = ev
+                rr = reqs[gid]
+                rr.status = status
+                rr.error = error
+                assigned.get(rank, set()).discard(gid)
+            elif kind == "reject":
+                _, gid, _retry = ev
+                rr = reqs[gid]
+                assigned.get(rank, set()).discard(gid)
+                rr.status = "pending"
+                rr.replica = None
+                pending.append(rr)
+            elif kind == "handoff_ready":
+                _, gid, tok = ev
+                rr = reqs[gid]
+                rr.tokens.append(int(tok))  # committed exactly once
+                del prefilling[gid]
+                if (
+                    len(rr.tokens) >= rr.spec["max_new_tokens"]
+                    or tok == rr.spec["stop_token"]
+                ):
+                    rr.status = "finished"
+                    continue
+                dest = pick_replica(rr)
+                if dest is None:
+                    rr.status = "pending"
+                    pending.append(rr)
+                    continue
+                gdest = plane.members[dest]
+                gsrc = plane.members[rank]
+                migrating[gid] = (rank, dest)
+                if send_cmd(rank, {"op": "send_snapshot", "gid": gid,
+                                   "dest": gdest}):
+                    send_cmd(dest, {
+                        "op": "recv_snapshot", "gid": gid,
+                        "source": gsrc,
+                        "prompt": list(rr.spec["prompt"]),
+                        "max_new_tokens": rr.spec["max_new_tokens"],
+                        "sampling": rr.spec["sampling"],
+                        "stop_token": rr.spec["stop_token"],
+                        "timeout_s": rr.spec["timeout_s"],
+                        "committed": list(rr.tokens),
+                    })
+            elif kind == "adopted":
+                _, gid = ev
+                rr = reqs[gid]
+                migrating.pop(gid, None)
+                rr.replica = rank
+                rr.status = "routed"
+                assigned[rank].add(gid)
+            elif kind == "handoff_failed":
+                _, gid, err = ev
+                rr = reqs[gid]
+                prefilling.pop(gid, None)
+                migrating.pop(gid, None)
+                if not rr.done:
+                    # Fall back to the plain path: re-prefill on a
+                    # decode replica with whatever prefix is committed.
+                    rr.failovers += 1
+                    rr.status = "pending"
+                    pending.append(rr)
+            elif kind == "load":
+                loads[rank] = ReplicaLoad.from_dict(ev[1])
+                roles[rank] = loads[rank].role
+
+    deadline = time.monotonic() + timeout_s
+    while any(not rr.done for rr in reqs.values()):
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"router did not finish within {timeout_s}s: "
+                f"{[(g, r.status) for g, r in reqs.items()]}"
+            )
+        if not alive:
+            for rr in reqs.values():
+                if not rr.done:
+                    rr.status = "failed"
+                    rr.error = "every replica died"
+            break
+        for rank in health.check():
+            on_dead(rank, "missed heartbeats")
+        # Place pending work.
+        still: List[_RemoteRequest] = []
+        for rr in pending:
+            if rr.done:
+                continue
+            prompt = rr.spec["prompt"]
+            prefills = [
+                r for r in sorted(alive) if roles.get(r) == "prefill"
+            ]
+            if (
+                prefill_threshold is not None
+                and not rr.tokens
+                and len(prompt) >= prefill_threshold
+                and prefills
+            ):
+                pr = min(prefills)
+                if send_cmd(pr, {
+                    "op": "prefill", "gid": rr.gid,
+                    "prompt": list(prompt),
+                    "sampling": rr.spec["sampling"],
+                }):
+                    prefilling[rr.gid] = pr
+                    rr.status = "prefill"
+                    continue
+            if not place(rr):
+                still.append(rr)
+        pending = still
+        # Drain events from every replica.
+        for rank in sorted(alive):
+            while True:
+                try:
+                    events = plane.recv(rank, tag=EVT,
+                                        timeout_ms=POLL_MS)
+                except TimeoutError:
+                    break
+                except PeerGone as e:
+                    on_dead(rank, str(e))
+                    break
+                handle_evt(rank, events)
+        if reporter is not None:
+            reporter.gauge("serving/cluster/replicas_alive", len(alive))
+    for rank in sorted(alive):
+        send_cmd(rank, {"op": "stop"})
+    return {
+        gid: {
+            "tokens": list(rr.tokens),
+            "status": rr.status,
+            "error": rr.error,
+            "failovers": rr.failovers,
+        }
+        for gid, rr in reqs.items()
+    }

@@ -35,7 +35,16 @@ from chainermn_tpu.serving.scheduler import (
 
 class QueueFull(RuntimeError):
     """Backpressure: the admission queue is at capacity.  Callers
-    should retry after draining some completions (or shed load)."""
+    should retry after draining some completions (or shed load).
+
+    ``retry_after_s`` — when the frontend has observed enough decode
+    throughput to estimate one — is the predicted seconds until the
+    nearest-to-done running request retires and frees a batch slot.
+    ``None`` means "no estimate" (cold frontend), not "retry now"."""
+
+    def __init__(self, msg: str, retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 @dataclasses.dataclass
@@ -83,6 +92,9 @@ class ServeFrontend:
     ``clock`` defaults to ``time.monotonic``; tests inject a fake.
     """
 
+    #: steps remembered by the decode-throughput estimator.
+    THROUGHPUT_WINDOW = 64
+
     def __init__(self, scheduler: ContinuousBatchingScheduler,
                  max_queue: int = 64,
                  clock: Callable[[], float] = time.monotonic):
@@ -91,26 +103,74 @@ class ServeFrontend:
         self.clock = clock
         self._handles: Dict[int, RequestHandle] = {}
         self._next_id = 0
+        # (timestamp, tokens emitted) per recent step — the decode
+        # throughput window retry-after hints are derived from.
+        self._step_times: List[tuple] = []
 
     # -- submission ----------------------------------------------------
     def queue_depth(self) -> int:
         return len(self.scheduler.waiting)
+
+    def reserve_id(self) -> int:
+        """Claim the next request id without enqueueing anything —
+        migration restores KV pages under the id BEFORE the request
+        object exists (see :meth:`adopt`)."""
+        rid = self._next_id
+        self._next_id += 1
+        return rid
+
+    def decode_tokens_per_sec(self) -> Optional[float]:
+        """Observed decode throughput over the recent step window, or
+        None before two timestamped steps exist."""
+        w = self._step_times
+        if len(w) < 2:
+            return None
+        elapsed = w[-1][0] - w[0][0]
+        tokens = sum(t for _, t in w[1:])
+        if elapsed <= 0 or tokens <= 0:
+            return None
+        return tokens / elapsed
+
+    def _retry_after_hint(self) -> Optional[float]:
+        """Seconds until a queue slot plausibly frees: the remaining
+        tokens of the nearest-to-done live request, at the observed
+        per-request step rate (aggregate throughput / live requests)."""
+        tput = self.decode_tokens_per_sec()
+        if tput is None:
+            return None
+        live = self.scheduler.running or list(self.scheduler.waiting)
+        if not live:
+            return None
+        nearest = min(
+            max(1, r.max_new_tokens - len(r.generated)) for r in live
+        )
+        return nearest * len(live) / tput
 
     def submit(self, prompt, max_new_tokens: int,
                sampling: Optional[SamplingParams] = None,
                stop_token: Optional[int] = None,
                timeout_s: Optional[float] = None,
                on_token: Optional[Callable[[int, int], None]] = None,
+               committed: Optional[List[int]] = None,
                ) -> RequestHandle:
-        """Enqueue one request; raises :class:`QueueFull` when the
+        """Enqueue one request; raises :class:`QueueFull` (with a
+        ``retry_after_s`` hint once throughput is known) when the
         waiting queue is at ``max_queue``.  ``on_token(request_id,
-        token)`` streams tokens as they are sampled."""
+        token)`` streams tokens as they are sampled.
+
+        ``committed`` — tokens this request already generated on a
+        previous replica (failover replay): they are preloaded into the
+        request so admission re-prefills prompt+committed and sampling
+        resumes at the next position, bit-identical to an uninterrupted
+        run (counter-based RNG).  ``on_token`` does NOT re-fire for
+        them — the caller already streamed them."""
         if self.queue_depth() >= self.max_queue:
-            raise QueueFull(
-                f"waiting queue at capacity ({self.max_queue})"
-            )
-        rid = self._next_id
-        self._next_id += 1
+            hint = self._retry_after_hint()
+            msg = f"waiting queue at capacity ({self.max_queue})"
+            if hint is not None:
+                msg += f"; retry after ~{hint:.3f}s"
+            raise QueueFull(msg, retry_after_s=hint)
+        rid = self.reserve_id()
         req = Request(
             request_id=rid,
             prompt=list(map(int, prompt)),
@@ -119,6 +179,8 @@ class ServeFrontend:
             stop_token=stop_token,
             on_token=on_token,
         )
+        if committed:
+            req.generated = list(map(int, committed))
         handle = RequestHandle(
             request_id=rid,
             submitted_at=self.clock(),
@@ -129,6 +191,22 @@ class ServeFrontend:
         self.scheduler.add_request(req)
         if req.done:  # rejected at intake (oversized / empty prompt)
             handle.finished_at = handle.submitted_at
+        return handle
+
+    def adopt(self, req: Request,
+              timeout_s: Optional[float] = None) -> RequestHandle:
+        """Register a request whose KV pages are already live in this
+        engine (restored under ``req.request_id``, reserved via
+        :meth:`reserve_id`) and admit it straight into the decode batch
+        — the receiving end of a cross-replica handoff."""
+        self.scheduler.adopt_request(req)
+        handle = RequestHandle(
+            request_id=req.request_id,
+            submitted_at=self.clock(),
+            timeout_s=timeout_s,
+            _request=req,
+        )
+        self._handles[req.request_id] = handle
         return handle
 
     # -- deadlines -----------------------------------------------------
@@ -164,6 +242,9 @@ class ServeFrontend:
         self._expire(self.clock())
         emitted = self.scheduler.step()
         now = self.clock()
+        self._step_times.append((now, emitted))
+        if len(self._step_times) > self.THROUGHPUT_WINDOW:
+            del self._step_times[: -self.THROUGHPUT_WINDOW]
         for h in self._handles.values():
             if h._request.done and h.finished_at is None:
                 h.finished_at = now

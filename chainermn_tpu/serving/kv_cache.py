@@ -143,6 +143,11 @@ class PagedKVCache:
     def block_table(self, seq_id) -> List[int]:
         return list(self._tables[seq_id])
 
+    def seq_len(self, seq_id) -> int:
+        """Token positions covered by ``seq_id``'s table — the length
+        migration snapshots (and restores) a sequence at."""
+        return self._lens[seq_id]
+
     def padded_table(self, seq_id, width: int) -> np.ndarray:
         """The (width,) int32 device view of a table: real page ids then
         the invalid sentinel.  ``width`` is the engine's bucketed

@@ -96,13 +96,20 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, engine: InferenceEngine,
                  watermark_blocks: Optional[int] = None,
-                 reporter=None):
+                 reporter=None, replica=None):
         self.engine = engine
         self.watermark = (
             engine.max_batch if watermark_blocks is None
             else int(watermark_blocks)
         )
         self.reporter = reporter
+        # In a multi-replica tier every scheduler publishes the same
+        # gauge names; a replica id suffixes them ("serving/running/
+        # replica/<id>") so tools.obs can split the fleet into
+        # per-replica Prometheus labels.  Default: bare names, exactly
+        # as the single-replica stack always published them.
+        self.replica = replica
+        self._gauge_suffix = "" if replica is None else f"/replica/{replica}"
         self.waiting: Deque[Request] = deque()
         self.running: List[Request] = []
         self._finished: Dict[int, Request] = {}
@@ -126,6 +133,38 @@ class ContinuousBatchingScheduler:
             self._finished[req.request_id] = req
             return
         self.waiting.append(req)
+
+    def adopt_request(self, req: Request) -> None:
+        """Admit a request whose KV pages are ALREADY allocated and
+        written under ``req.request_id`` — the cross-replica handoff
+        seam (migration / disaggregated prefill).  The pages must cover
+        exactly ``len(req.context) - 1`` positions: the same state a
+        locally-running request is in between iterations (its last
+        sampled token is written by the NEXT decode step), so the decode
+        loop continues it with no special casing.  Bypasses the queue
+        and the admission watermark: an adopted sequence already paid
+        its prefill elsewhere, and if pages run short later it preempts
+        like anyone else (eviction replays its full context here)."""
+        if req.request_id not in self.engine.kv:
+            raise ValueError(
+                f"adopt_request({req.request_id}): no KV allocation — "
+                "restore the migrated pages first"
+            )
+        covered = self.engine.kv.seq_len(req.request_id)
+        want = len(req.context) - 1
+        if covered != want:
+            raise ValueError(
+                f"adopt_request({req.request_id}): pages cover {covered} "
+                f"positions, context of {want + 1} tokens needs {want} "
+                "(last token is written by the next decode step)"
+            )
+        if len(self.running) >= self.engine.max_batch:
+            raise OutOfBlocks(
+                f"adopt_request({req.request_id}): decode batch already "
+                f"at max_batch {self.engine.max_batch}"
+            )
+        req.state = RequestState.RUNNING
+        self.running.append(req)
 
     # -- policy helpers ------------------------------------------------
     def _admit(self) -> List[Request]:
@@ -249,11 +288,17 @@ class ContinuousBatchingScheduler:
 
         if self.reporter is not None:
             st = self.engine.kv.stats()
-            self.reporter.gauge("serving/cache_utilization",
+            sfx = self._gauge_suffix
+            self.reporter.gauge(f"serving/cache_utilization{sfx}",
                                 st.utilization)
-            self.reporter.gauge("serving/used_blocks", st.used_blocks)
-            self.reporter.gauge("serving/running", len(self.running))
-            self.reporter.gauge("serving/waiting", len(self.waiting))
+            self.reporter.gauge(f"serving/used_blocks{sfx}",
+                                st.used_blocks)
+            self.reporter.gauge(f"serving/free_blocks{sfx}",
+                                st.free_blocks)
+            self.reporter.gauge(f"serving/running{sfx}",
+                                len(self.running))
+            self.reporter.gauge(f"serving/waiting{sfx}",
+                                len(self.waiting))
             if emitted:
                 self.reporter.count("serving/tokens", emitted)
         return emitted

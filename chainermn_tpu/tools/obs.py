@@ -211,14 +211,25 @@ def to_prometheus(summary: dict, prefix: str = "chainermn_tpu") -> str:
                 for k, v in sorted(spans.items())])
     gauges = summary.get("gauges")
     if gauges:
+        # Per-replica serving gauges ("serving/running/replica/<id>", as
+        # a multi-replica tier's schedulers publish them) split the
+        # replica id into its own label so a fleet scrapes cleanly:
+        # one metric name, N labeled series.
+        def gauge_labels(name):
+            base, sep, rid = name.rpartition("/replica/")
+            if sep and rid:
+                return (("name", base), ("replica", rid))
+            return (("name", name),)
+
+        samples = sorted(
+            (gauge_labels(k), v) for k, v in gauges.items()
+        )
         metric("gauge", "gauge",
                "Set-style gauges, last value per rank summed across ranks",
-               [((("name", k),), v["sum"])
-                for k, v in sorted(gauges.items())])
+               [(labels, v["sum"]) for labels, v in samples])
         metric("gauge_max", "gauge",
                "Most-loaded rank's value per set-style gauge",
-               [((("name", k),), v["max"])
-                for k, v in sorted(gauges.items())])
+               [(labels, v["max"]) for labels, v in samples])
     coll = summary.get("collectives")
     if coll:
         metric("collective_ops_total", "counter",

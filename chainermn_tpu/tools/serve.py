@@ -1,0 +1,332 @@
+"""Multi-replica serving CLI.
+
+Launches the serving cluster tier from the shell in either of two
+shapes:
+
+``--role local`` (default)
+    Everything in this process: N in-process replicas behind a
+    :class:`~chainermn_tpu.serving.cluster.ReplicaRouter`, threaded
+    per-replica stepping, synthetic request traffic, one JSON report on
+    stdout.  ``--verify`` additionally replays every prompt through a
+    sequential single-engine oracle and asserts the routed streams are
+    bit-identical — the smoke test CI runs.
+
+``--role router`` / ``--role replica``
+    One process per role over the host object plane (the
+    :mod:`~chainermn_tpu.serving.cluster.service` wire protocol).
+    Every process first joins the same ``jax.distributed`` coordinator
+    (``--coordinator host:port --num-processes N --process-id i``);
+    process 0 must be the router.  The router drives the synthetic
+    traffic and prints the same JSON report shape.
+
+Usage::
+
+    # in-process smoke: 2 replicas, oracle parity check
+    python -m chainermn_tpu.tools.serve --replicas 2 --verify
+
+    # disaggregated roles: replica 0 prefills, replica 1 decodes
+    python -m chainermn_tpu.tools.serve --replicas 2 \
+        --roles prefill,decode --prefill-threshold 16
+
+    # multi-process (three shells):
+    python -m chainermn_tpu.tools.serve --role router \
+        --coordinator 127.0.0.1:9123 --num-processes 3 --process-id 0
+    python -m chainermn_tpu.tools.serve --role replica \
+        --coordinator 127.0.0.1:9123 --num-processes 3 --process-id 1
+    python -m chainermn_tpu.tools.serve --role replica \
+        --coordinator 127.0.0.1:9123 --num-processes 3 --process-id 2
+
+The model is the repo's own TransformerLM with randomly initialized
+parameters (geometry from the ``--vocab``/``--d-model``/... flags);
+every process derives identical params from ``--seed``, which is what
+makes cross-replica migration and the oracle parity check meaningful.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional
+
+
+def _build_model(args):
+    import jax
+    import jax.numpy as jnp
+
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(
+        vocab=args.vocab, d_model=args.d_model, n_heads=args.heads,
+        d_ff=args.d_ff, n_layers=args.layers, max_len=args.max_len,
+    )
+    params = model.init(
+        jax.random.PRNGKey(args.seed), jnp.zeros((1, 8), jnp.int32)
+    )
+    return model, params
+
+
+def _engine_factory(args):
+    from chainermn_tpu.serving import EngineConfig, InferenceEngine
+
+    model, params = _build_model(args)
+
+    def factory():
+        return InferenceEngine(model, params, EngineConfig(
+            block_size=args.block_size, n_blocks=args.n_blocks,
+            max_len=args.max_len, max_batch=args.max_batch,
+        ))
+
+    return factory
+
+
+def _synthetic_prompts(args) -> List[List[int]]:
+    import numpy as np
+
+    rng = np.random.default_rng(args.seed)
+    lens = rng.integers(
+        max(1, args.prompt_len // 2), args.prompt_len + 1,
+        size=args.requests,
+    )
+    return [
+        [int(t) for t in rng.integers(1, args.vocab, size=int(n))]
+        for n in lens
+    ]
+
+
+def _parse_roles(spec: Optional[str], n: int) -> List[str]:
+    from chainermn_tpu.serving.cluster.replica import ROLES
+
+    if not spec:
+        return ["both"] * n
+    roles = [r.strip() for r in spec.split(",")]
+    if len(roles) != n:
+        raise SystemExit(
+            f"--roles names {len(roles)} roles for {n} replicas"
+        )
+    for r in roles:
+        if r not in ROLES:
+            raise SystemExit(f"unknown role {r!r} (choose from {ROLES})")
+    return roles
+
+
+def _report(args, results: dict, wall: float, extra: dict) -> dict:
+    tokens = sum(len(r["tokens"]) for r in results.values())
+    statuses: dict = {}
+    for r in results.values():
+        statuses[r["status"]] = statuses.get(r["status"], 0) + 1
+    report = {
+        "mode": args.role,
+        "replicas": args.replicas,
+        "requests": len(results),
+        "statuses": statuses,
+        "tokens": tokens,
+        "tokens_per_sec": round(tokens / wall, 1) if wall > 0 else None,
+        "failovers": sum(r["failovers"] for r in results.values()),
+        "config": {
+            "vocab": args.vocab, "d_model": args.d_model,
+            "n_layers": args.layers, "max_len": args.max_len,
+            "block_size": args.block_size, "n_blocks": args.n_blocks,
+            "max_batch": args.max_batch, "max_queue": args.max_queue,
+            "watermark_blocks": args.watermark,
+            "prefill_threshold": args.prefill_threshold,
+        },
+    }
+    report.update(extra)
+    return report
+
+
+def _oracle_streams(args, prompts) -> List[List[int]]:
+    """Sequential single-engine reference streams (one fresh engine so
+    cache state can't leak between the oracle and the cluster)."""
+    eng = _engine_factory(args)()
+    return [eng.generate(p, args.new_tokens) for p in prompts]
+
+
+def run_local(args) -> int:
+    from chainermn_tpu.serving.cluster import (
+        HeartbeatMonitor,
+        Replica,
+        ReplicaRouter,
+        ThreadedClusterDriver,
+    )
+
+    factory = _engine_factory(args)
+    roles = _parse_roles(args.roles, args.replicas)
+    replicas = [
+        Replica(
+            i, factory(), role=roles[i],
+            watermark_blocks=args.watermark, max_queue=args.max_queue,
+        )
+        for i in range(args.replicas)
+    ]
+    router = ReplicaRouter(
+        replicas,
+        prefill_threshold=args.prefill_threshold,
+        health=HeartbeatMonitor(
+            [r.replica_id for r in replicas], miss_after_s=30.0
+        ),
+    )
+    prompts = _synthetic_prompts(args)
+
+    t0 = time.perf_counter()
+    with ThreadedClusterDriver(router) as drv:
+        handles = [
+            router.submit(p, args.new_tokens, timeout_s=args.timeout_s)
+            for p in prompts
+        ]
+        drv.run_until_idle(timeout_s=args.timeout_s)
+    wall = time.perf_counter() - t0
+
+    results = {
+        h.request_id: {
+            "tokens": list(h.tokens), "status": h.status,
+            "failovers": h.failovers,
+        }
+        for h in handles
+    }
+    extra = {
+        "roles": roles,
+        "replicas_used": sorted(
+            {repr(h.replica_id) for h in handles
+             if h.replica_id is not None}
+        ),
+    }
+    if args.verify:
+        oracle = _oracle_streams(args, prompts)
+        mismatches = [
+            i for i, (h, o) in enumerate(zip(handles, oracle))
+            if h.tokens != o
+        ]
+        extra["parity"] = "ok" if not mismatches else "FAIL"
+        extra["parity_mismatches"] = mismatches
+    print(json.dumps(_report(args, results, wall, extra)))
+    if args.verify and extra["parity"] != "ok":
+        return 1
+    if any(r["status"] != "finished" for r in results.values()):
+        return 1
+    return 0
+
+
+def _init_distributed(args) -> None:
+    import jax
+
+    if not args.coordinator:
+        raise SystemExit(
+            "--role router/replica needs --coordinator host:port"
+        )
+    jax.distributed.initialize(
+        coordinator_address=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+    )
+
+
+def run_multiprocess(args) -> int:
+    from chainermn_tpu.serving.cluster import service
+
+    _init_distributed(args)
+    size = args.num_processes
+    if args.role == "replica":
+        role = (args.replica_role or "both")
+        out = service.run_replica(
+            args.process_id, size, _engine_factory(args),
+            role=role, max_queue=args.max_queue,
+            watermark_blocks=args.watermark,
+        )
+        print(json.dumps({"mode": "replica", "rank": args.process_id,
+                          **out}))
+        return 0
+
+    if args.process_id != 0:
+        raise SystemExit("--role router must be --process-id 0")
+    args.replicas = size - 1
+    prompts = _synthetic_prompts(args)
+    requests = [
+        {"prompt": p, "max_new_tokens": args.new_tokens,
+         "timeout_s": args.timeout_s}
+        for p in prompts
+    ]
+    t0 = time.perf_counter()
+    results = service.run_router(
+        size, requests,
+        prefill_threshold=args.prefill_threshold,
+        timeout_s=args.timeout_s,
+    )
+    wall = time.perf_counter() - t0
+    extra = {}
+    if args.verify:
+        oracle = _oracle_streams(args, prompts)
+        mismatches = [
+            g for g, o in enumerate(oracle)
+            if results[g]["tokens"] != o
+        ]
+        extra["parity"] = "ok" if not mismatches else "FAIL"
+        extra["parity_mismatches"] = mismatches
+    print(json.dumps(_report(args, results, wall, extra)))
+    if extra.get("parity") == "FAIL":
+        return 1
+    if any(r["status"] != "finished" for r in results.values()):
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m chainermn_tpu.tools.serve",
+        description="Run the multi-replica serving tier on synthetic "
+                    "traffic (in-process or one process per role).",
+    )
+    ap.add_argument("--role", choices=["local", "router", "replica"],
+                    default="local")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="replica count for --role local")
+    ap.add_argument("--roles", default=None,
+                    help="comma-separated per-replica roles for --role "
+                         "local (prefill|decode|both; default all both)")
+    ap.add_argument("--replica-role", default=None,
+                    choices=["prefill", "decode", "both"],
+                    help="this process's role for --role replica")
+    ap.add_argument("--prefill-threshold", type=int, default=None,
+                    help="prompts at least this long go to a "
+                         "prefill-role replica first (disaggregation)")
+    ap.add_argument("--watermark", type=int, default=None,
+                    help="free-page admission watermark per replica")
+    ap.add_argument("--max-queue", type=int, default=64,
+                    help="bounded frontend queue size per replica")
+    ap.add_argument("--verify", action="store_true",
+                    help="replay through a sequential oracle and fail "
+                         "unless streams are bit-identical")
+    ap.add_argument("--timeout-s", type=float, default=120.0)
+    # traffic
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=12,
+                    help="max synthetic prompt length (min is half)")
+    ap.add_argument("--new-tokens", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=0)
+    # model geometry
+    ap.add_argument("--vocab", type=int, default=128)
+    ap.add_argument("--d-model", type=int, default=32)
+    ap.add_argument("--heads", type=int, default=2)
+    ap.add_argument("--d-ff", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--max-len", type=int, default=128)
+    # engine
+    ap.add_argument("--block-size", type=int, default=8)
+    ap.add_argument("--n-blocks", type=int, default=128)
+    ap.add_argument("--max-batch", type=int, default=4)
+    # multi-process wiring
+    ap.add_argument("--coordinator", default=None,
+                    help="jax.distributed coordinator host:port")
+    ap.add_argument("--num-processes", type=int, default=None)
+    ap.add_argument("--process-id", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    if args.role == "local":
+        return run_local(args)
+    return run_multiprocess(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,115 @@
+"""Worker for the multi-process serving soak test.
+
+Run as: python _mp_serve_worker.py <pid> <nproc> <port> <kill_after>
+
+A REAL serving fleet under one jax.distributed coordinator: rank 0 runs
+the service-loop router (:func:`service.run_router`), every other rank a
+replica (:func:`service.run_replica`).  With ``kill_after > 0`` the
+HIGHEST rank SIGKILLs itself after streaming that many tokens —
+mid-request, sequences live in its page pool, no cleanup — and the
+router must detect the death (socket EOF → PeerGone, or missed
+heartbeats), re-place the orphaned requests on the survivor with their
+committed token prefix, and still return every stream BIT-IDENTICAL to
+a sequential single-engine oracle.  The survivor's page pool passes
+``assert_consistent`` on clean stop (checked inside run_replica).
+
+Rank 0 prints ``SERVE_SOAK_OK`` after verifying all streams; surviving
+replicas print ``SERVE_REPLICA_OK <pid>``.  The killed rank's "output"
+is its -9 exit status.
+"""
+
+import os
+import sys
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    kill_after = int(sys.argv[4])
+
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    # Force backend init NOW on every rank: the CPU client's global
+    # topology exchange blocks until all processes join, and the router
+    # rank would otherwise never touch jax before its oracle check.
+    jax.devices()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chainermn_tpu.models.transformer import TransformerLM
+    from chainermn_tpu.serving import EngineConfig, InferenceEngine
+    from chainermn_tpu.serving.cluster import service
+
+    def engine_factory():
+        lm = TransformerLM(vocab=32, d_model=16, n_heads=2, d_ff=32,
+                           n_layers=2, max_len=64)
+        params = lm.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 8), jnp.int32))
+        return InferenceEngine(lm, params, EngineConfig(
+            block_size=4, n_blocks=64, max_len=64, max_batch=2,
+        ))
+
+    rng = np.random.default_rng(13)
+    prompts = [
+        [int(t) for t in rng.integers(0, 32, size=int(n))]
+        for n in rng.integers(4, 11, size=6)
+    ]
+    NEW = 8
+
+    if pid == 0:
+        requests = [
+            {"prompt": p, "max_new_tokens": NEW} for p in prompts
+        ]
+        # miss_after_s must tolerate a replica stalled in a cold jit
+        # compile (seconds on CPU); REAL deaths are detected much
+        # faster via socket EOF -> PeerGone on the event edge.
+        results = service.run_router(
+            nproc, requests, miss_after_s=30.0, timeout_s=180.0,
+        )
+        try:
+            oracle = engine_factory()
+            failovers = 0
+            for gid, p in enumerate(prompts):
+                rr = results[gid]
+                assert rr["status"] == "finished", (gid, rr)
+                want = oracle.generate(p, NEW)
+                assert rr["tokens"] == want, (gid, rr["tokens"], want)
+                failovers += rr["failovers"]
+            if kill_after > 0:
+                assert failovers > 0, "nobody failed over despite kill"
+        except BaseException:
+            import traceback
+
+            traceback.print_exc()
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(1)  # don't hang in the atexit shutdown barrier
+        print("SERVE_SOAK_OK")
+        # Skip jax's atexit shutdown barrier: with a SIGKILLed rank in
+        # the world it blocks until the coordination service aborts us.
+        sys.stdout.flush()
+        os._exit(0)
+
+    # Replicas.  max_queue=3 forces the router to spread the burst over
+    # both replicas (cold-start placement prefers the lowest rank until
+    # its queue fills), so the doomed rank is guaranteed live work.
+    doomed = kill_after > 0 and pid == nproc - 1
+    out = service.run_replica(
+        pid, nproc, engine_factory, max_queue=3,
+        kill_after_tokens=kill_after if doomed else None,
+    )
+    print(f"SERVE_REPLICA_OK {pid} {out['reason']}")
+    sys.stdout.flush()
+    os._exit(0)  # see rank 0: no shutdown barrier with a corpse in it
+
+
+if __name__ == "__main__":
+    main()

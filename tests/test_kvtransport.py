@@ -252,3 +252,130 @@ def test_object_plane_gather_root_timeout(monkeypatch):
     with pytest.raises(TimeoutError):  # same type as the socket plane's
         root.gather("root-obj", 0, timeout_ms=300)
     assert time.monotonic() - t0 < 10.0  # bounded, not a hang
+
+
+# ---------------------------------------------------------------------------
+# Peer-death churn: PeerGone, queued-message delivery, re-handshake
+# ---------------------------------------------------------------------------
+
+
+def test_peer_death_raises_peer_gone_fast(sock_pair):
+    """EOF from a connected peer converts blocked/future recvs into
+    PeerGone well before the caller's timeout — waiting out a 30 s
+    deadline on a corpse is the hang this rules out."""
+    p0, p1 = sock_pair
+    p0.send("c", 1, 0, 0, "hello")
+    assert p1.recv("c", 0, 0, 0, timeout_ms=20_000) == "hello"
+
+    p0._send_socks[1].close()  # peer 0's process "dies"
+    t0 = time.monotonic()
+    with pytest.raises(kv.PeerGone) as e:
+        p1.recv("c", 0, 0, 1, timeout_ms=60_000)
+    assert time.monotonic() - t0 < 10
+    assert e.value.peer == 0
+    assert p1.peer_gone(0) is not None
+
+
+def test_peer_death_delivers_queued_messages_first(sock_pair):
+    """Frames that arrived before the peer died are real data — death
+    must not destroy them.  The PeerGone marker queues BEHIND them."""
+    p0, p1 = sock_pair
+    p0.send("c", 1, 3, 0, "one")
+    p0.send("c", 1, 3, 1, "two")
+    # Wait until both frames are parked so the close can't race them.
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        q = p1._queue(("c", 0, 3))
+        if q.qsize() >= 2:
+            break
+        time.sleep(0.01)
+    p0._send_socks[1].close()
+    assert p1.recv("c", 0, 3, 0, timeout_ms=20_000) == "one"
+    assert p1.recv("c", 0, 3, 1, timeout_ms=20_000) == "two"
+    with pytest.raises(kv.PeerGone):
+        p1.recv("c", 0, 3, 2, timeout_ms=60_000)
+
+
+def test_partial_frame_death_is_peer_gone(sock_pair):
+    """Death MID-FRAME (header sent, payload truncated) is still clean
+    peer death, not a malformed-frame poisoning: the incomplete frame
+    is dropped and recv raises PeerGone."""
+    import struct
+
+    p0, p1 = sock_pair
+    p0.send("c", 1, 4, 0, "intact")
+    assert p1.recv("c", 0, 4, 0, timeout_ms=20_000) == "intact"
+
+    sock = p0._send_socks[1]
+    hdr = (
+        b'{"kind": "pkl", "nbytes": 64, "ns": "c", "src": 0, '
+        b'"tag": 4, "seq": 1}'
+    )
+    sock.sendall(struct.pack("<I", len(hdr)) + hdr + b"\x00" * 10)
+    sock.close()  # dies 54 bytes short of its own header's promise
+    with pytest.raises(kv.PeerGone):
+        p1.recv("c", 0, 4, 1, timeout_ms=60_000)
+    assert p1._broken is None  # transport NOT poisoned: peers can talk
+
+
+def test_replacement_peer_rehandshakes_after_death(sock_pair):
+    """After PeerGone, a REPLACEMENT process at the same rank can
+    republish its endpoint and resume the stream: the survivor's stale
+    gone-markers are skipped, not fatal."""
+    p0, p1 = sock_pair
+    p0.send("c", 1, 5, 0, "before")
+    assert p1.recv("c", 0, 5, 0, timeout_ms=20_000) == "before"
+    p0._send_socks[1].close()
+    with pytest.raises(kv.PeerGone):
+        p1.recv("c", 0, 5, 1, timeout_ms=60_000)
+
+    # Same-rank replacement: a fresh plane re-publishes rank 0's
+    # endpoint (delete-then-set) and connects anew.
+    p0b = kv.SocketPlane(0)
+    p0b.send("c", 1, 5, 1, "after")
+    # recv may still fast-fail PeerGone until the reader processes the
+    # replacement's first frame — exactly the window retry_backoff is
+    # for (send() returning does not mean the survivor routed it yet).
+    got = kv.retry_backoff(
+        lambda: p1.recv("c", 0, 5, 1, timeout_ms=20_000),
+        retries=6, base_s=0.05,
+    )
+    assert got == "after"
+    assert p1.peer_gone(0) is None  # revived
+    # The replaced endpoint is the one future connects reach.
+    p1.send("c", 0, 6, 0, "to-replacement")
+    assert p0b.recv("c", 1, 6, 0, timeout_ms=20_000) == "to-replacement"
+
+
+def test_send_to_dead_peer_raises_peer_gone(sock_pair, monkeypatch):
+    """Connecting to a dead endpoint fails as PeerGone (retryable via
+    retry_backoff), not a raw OSError.  The dead endpoint is port 1
+    (privileged, never listening, never ephemeral) rather than the
+    peer's closed port: on loopback, connecting to a just-freed port
+    can land a TCP self-connection when the kernel picks it as the
+    ephemeral source port too."""
+    p0, p1 = sock_pair
+    key = f"{kv._PREFIX}/sockep/1"
+    host, _port, token = kv.client().d[key].rsplit(":", 2)
+    kv.client().key_value_set(key, f"{host}:1:{token}")
+    p0._send_socks.pop(1, None)
+    with pytest.raises(kv.PeerGone):
+        p0.send("c", 1, 0, 0, "anyone home?")
+
+
+def test_retry_backoff_retries_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise kv.PeerGone("not yet", peer=7)
+        return "ok"
+
+    assert kv.retry_backoff(flaky, retries=4, base_s=0.001) == "ok"
+    assert len(calls) == 3
+    with pytest.raises(kv.PeerGone):
+        kv.retry_backoff(
+            lambda: (_ for _ in ()).throw(kv.PeerGone("always")),
+            retries=2, base_s=0.001,
+        )

@@ -266,3 +266,57 @@ def test_kill9_and_resume_bit_identical_four_ranks(tmp_path):
     assert m, "relaunch did not resume:\n" + "\n".join(outs)
     assert int(m.group(1)) >= 5
     assert _digest(outs) == oracle
+
+
+_PEERGONE_WORKER = os.path.join(
+    os.path.dirname(__file__), "_mp_peergone_worker.py"
+)
+
+
+def test_peer_death_mid_send_peergone_and_replacement():
+    """Transport churn over REAL process boundaries: rank 1 dies by
+    SIGKILL mid-frame; the survivor gets PeerGone inside its timeout
+    (not a hang), accepts a same-rank replacement incarnation
+    (endpoint republished through the real coordination-service KV),
+    and keeps talking to an unrelated peer."""
+    procs, outs = _launch(_PEERGONE_WORKER, nproc=3, n_devices=1,
+                          timeout=300)
+    codes = [p.returncode for p in procs]
+    assert codes[1] == -9, f"rank 1 should die by SIGKILL: {codes}\n" \
+        + "\n".join(outs)
+    for i in (0, 2):
+        assert codes[i] == 0, f"survivor {i} failed:\n{outs[i]}"
+        assert f"MP_PEERGONE_OK {i}" in outs[i], outs[i]
+
+
+_SERVE_WORKER = os.path.join(
+    os.path.dirname(__file__), "_mp_serve_worker.py"
+)
+
+
+def test_serving_cluster_survives_replica_kill9():
+    """The serving-fleet soak: router + 2 replica processes, the highest
+    rank SIGKILLed mid-stream with live sequences in its pool.  Every
+    request must still finish with a token stream bit-identical to the
+    sequential single-engine oracle (failover re-prefills from the
+    committed prefix), and the survivor's page pool must pass
+    assert_consistent on clean stop."""
+    procs, outs = _launch(_SERVE_WORKER, 3, "5", n_devices=1, timeout=420)
+    codes = [p.returncode for p in procs]
+    assert codes[2] == -9, f"rank 2 should die by SIGKILL: {codes}\n" \
+        + "\n".join(outs)
+    assert codes[0] == 0, f"router failed:\n{outs[0]}"
+    assert "SERVE_SOAK_OK" in outs[0], outs[0]
+    assert codes[1] == 0, f"survivor replica failed:\n{outs[1]}"
+    assert "SERVE_REPLICA_OK 1" in outs[1], outs[1]
+
+
+def test_serving_cluster_clean_run_no_kill():
+    """Same fleet, nobody dies: all streams oracle-exact, zero
+    failovers, both replicas stop cleanly."""
+    procs, outs = _launch(_SERVE_WORKER, 3, "0", n_devices=1, timeout=420)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+    assert "SERVE_SOAK_OK" in outs[0]
+    assert "SERVE_REPLICA_OK 1" in outs[1]
+    assert "SERVE_REPLICA_OK 2" in outs[2]

@@ -1,0 +1,607 @@
+"""Multi-replica serving tier: router, migration, disaggregation,
+failover health.
+
+The cluster-level contract extends the single-engine one from
+tests/test_serving.py:
+
+1. **Bit-exact routing** — a token stream is identical whether a
+   request runs alone through ``engine.generate``, shares one
+   replica's continuous batch, or crosses replicas (failover re-prefill
+   from the committed prefix, prefill→decode KV-page migration).
+   Counter-based sampling makes the stream a pure function of
+   ``(prompt, committed prefix, position)``.
+2. **KV conservation across migration** — extract + restore moves a
+   live sequence between pools with ``assert_consistent`` holding on
+   both sides and the pages bit-equal over the wire.
+3. **Load-aware placement** — the router spreads decode work, honors
+   roles/draining/watermark admissibility, and propagates the
+   frontend's throughput-derived retry-after hint when every queue is
+   full.
+4. **Liveness** — heartbeat death detection re-queues exactly the dead
+   replica's in-flight requests; survivors never see corrupted state.
+
+All CPU, in-process (threads at most).  The cross-process service loop
+soaks in tests/test_multiprocess.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.serving import (
+    EngineConfig,
+    InferenceEngine,
+    OutOfBlocks,
+    QueueFull,
+    Request,
+    SamplingParams,
+)
+from chainermn_tpu.serving.cluster import (
+    HeartbeatMonitor,
+    Replica,
+    ReplicaRouter,
+    ThreadedClusterDriver,
+    extract_sequence,
+    recv_snapshot,
+    restore_sequence,
+    scale_signals,
+    send_snapshot,
+)
+
+VOCAB = 32
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    return TransformerLM(vocab=VOCAB, d_model=16, n_heads=2, d_ff=32,
+                         n_layers=2, max_len=64)
+
+
+@pytest.fixture(scope="module")
+def lm_params(lm):
+    return lm.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def make_engine(lm, lm_params, **over):
+    cfg = dict(block_size=4, n_blocks=64, max_len=64, max_batch=4)
+    cfg.update(over)
+    return InferenceEngine(lm, lm_params, EngineConfig(**cfg))
+
+
+def prompts_for(n, rng_seed=7, lo=3, hi=13):
+    rng = np.random.default_rng(rng_seed)
+    return [
+        [int(t) for t in rng.integers(0, VOCAB, size=int(l))]
+        for l in rng.integers(lo, hi, size=n)
+    ]
+
+
+def oracle_streams(lm, lm_params, prompts, n):
+    """Sequential single-engine reference — a FRESH engine per call so
+    no cluster state can leak into the baseline."""
+    eng = make_engine(lm, lm_params)
+    return [eng.generate(p, n) for p in prompts]
+
+
+# ---------------------------------------------------------------------------
+# Unit seams: seq_len, adopt_request, retry-after hint
+# ---------------------------------------------------------------------------
+
+
+def test_kv_seq_len_tracks_allocation(lm, lm_params):
+    eng = make_engine(lm, lm_params)
+    eng.kv.allocate("s", 6)
+    assert eng.kv.seq_len("s") == 6
+    eng.kv.extend("s", 9)
+    assert eng.kv.seq_len("s") == 9
+    eng.kv.free("s")
+    with pytest.raises(KeyError):
+        eng.kv.seq_len("s")
+
+
+def test_adopt_request_validates_cache_state(lm, lm_params):
+    from chainermn_tpu.serving import ContinuousBatchingScheduler
+
+    eng = make_engine(lm, lm_params)
+    sched = ContinuousBatchingScheduler(eng)
+    req = Request(request_id="r", prompt=[1, 2, 3], max_new_tokens=4)
+    req.generated = [5]
+    # no pages for "r" at all
+    with pytest.raises(ValueError):
+        sched.adopt_request(req)
+    # pages covering the wrong number of positions
+    eng.kv.allocate("r", 2)
+    with pytest.raises(ValueError):
+        sched.adopt_request(req)
+    eng.kv.extend("r", len(req.context) - 1)
+    sched.adopt_request(req)
+    assert req in sched.running
+    # adoption is batch-capacity bounded (retryable, not terminal)
+    for i in range(eng.max_batch - 1):
+        sched.running.append(
+            Request(request_id=i, prompt=[1], max_new_tokens=1)
+        )
+    r2 = Request(request_id="r2", prompt=[1, 2], max_new_tokens=4)
+    eng.kv.allocate("r2", 1)
+    with pytest.raises(OutOfBlocks):
+        sched.adopt_request(r2)
+
+
+def test_adopted_request_stream_is_bit_exact(lm, lm_params):
+    """Adoption = exactly the state a locally-running request has
+    between iterations: prefill by hand, adopt, finish — stream matches
+    the sequential engine."""
+    from chainermn_tpu.serving import ContinuousBatchingScheduler
+
+    prompt = prompts_for(1)[0]
+    [want] = oracle_streams(lm, lm_params, [prompt], 6)
+
+    eng = make_engine(lm, lm_params)
+    sched = ContinuousBatchingScheduler(eng)
+    req = Request(request_id="a", prompt=prompt, max_new_tokens=6)
+    eng.kv.allocate("a", len(prompt))
+    logits = eng.prefill(prompt, "a")
+    req.generated = [eng.sample(logits, req.sampling, len(prompt))]
+    sched.adopt_request(req)
+    sched.run_to_completion()
+    assert req.generated == want
+
+
+def test_frontend_retry_after_hint_from_throughput(lm, lm_params):
+    from chainermn_tpu.serving import (
+        ContinuousBatchingScheduler,
+        ServeFrontend,
+    )
+
+    fe = ServeFrontend(
+        ContinuousBatchingScheduler(make_engine(lm, lm_params)),
+        max_queue=2,
+    )
+    p = prompts_for(1)[0]
+    # cold: no throughput estimate yet, hint is None
+    fe.submit(p, 8)
+    fe.submit(p, 8)
+    with pytest.raises(QueueFull) as e1:
+        fe.submit(p, 8)
+    assert e1.value.retry_after_s is None
+    assert fe.decode_tokens_per_sec() is None
+    for _ in range(4):
+        fe.step()
+    assert fe.decode_tokens_per_sec() > 0
+    fe.submit(p, 8)   # the first two are running now; queue refills
+    fe.submit(p, 8)
+    with pytest.raises(QueueFull) as e2:
+        fe.submit(p, 8)
+    assert e2.value.retry_after_s > 0
+    assert "retry after" in str(e2.value)
+    fe.run_until_idle()
+
+
+# ---------------------------------------------------------------------------
+# Router: load-aware placement, parity, backpressure
+# ---------------------------------------------------------------------------
+
+
+def _mk_cluster(lm, lm_params, n=2, roles=None, **router_kw):
+    reps = [
+        Replica(i, make_engine(lm, lm_params),
+                role=(roles[i] if roles else "both"),
+                max_queue=router_kw.pop(f"_q{i}", 8))
+        for i in range(n)
+    ]
+    return reps, ReplicaRouter(reps, **router_kw)
+
+
+def test_router_parity_and_load_spread(lm, lm_params):
+    prompts = prompts_for(6, rng_seed=3)
+    want = oracle_streams(lm, lm_params, prompts, 8)
+    reps, router = _mk_cluster(lm, lm_params, n=2)
+    handles = [router.submit(p, 8) for p in prompts]
+    router.run_until_idle()
+    for h, w in zip(handles, want):
+        assert h.status == "finished"
+        assert router.result(h) == w
+    # load-aware scoring spreads concurrent work over both replicas
+    assert {h.replica_id for h in handles} == {0, 1}
+    for r in reps:
+        r.engine.kv.assert_consistent()
+
+
+def test_router_respects_draining_and_roles(lm, lm_params):
+    reps, router = _mk_cluster(lm, lm_params, n=2)
+    router.drain(0)
+    h = router.submit(prompts_for(1)[0], 4)
+    router.run_until_idle()
+    assert h.replica_id == 1
+    # prefill-only replicas never take decode placements
+    reps2, router2 = _mk_cluster(lm, lm_params, n=2,
+                                 roles=["prefill", "both"])
+    h2 = router2.submit(prompts_for(1)[0], 4)
+    router2.run_until_idle()
+    assert h2.replica_id == 1
+
+
+def test_router_queue_full_propagates_min_hint(lm, lm_params):
+    reps = [Replica(0, make_engine(lm, lm_params, max_batch=1),
+                    max_queue=1)]
+    router = ReplicaRouter(reps)
+    p = prompts_for(1)[0]
+    router.submit(p, 8)
+    with pytest.raises(QueueFull):
+        router.submit(p, 8)
+    router.run_until_idle()
+
+
+def test_router_failover_is_bit_exact(lm, lm_params):
+    """Kill a replica mid-stream: its requests re-place on the
+    survivor with the committed prefix replayed — streams stay
+    bit-identical to the sequential oracle and the survivor's cache
+    invariants hold."""
+    prompts = prompts_for(6, rng_seed=11, lo=4, hi=10)
+    want = oracle_streams(lm, lm_params, prompts, 8)
+    reps, router = _mk_cluster(
+        lm, lm_params, n=2,
+        health=HeartbeatMonitor([0, 1], miss_after_s=1e9),
+    )
+    handles = [router.submit(p, 8) for p in prompts]
+    for _ in range(3):  # some tokens committed on both replicas
+        router.step()
+    victim = next(h.replica_id for h in handles if not h.done)
+    survivor = 1 - victim
+    requeued = router.fail_replica(victim, "test kill")
+    assert requeued > 0
+    router.run_until_idle()
+    for h, w in zip(handles, want):
+        assert h.status == "finished"
+        assert h.tokens == w
+    assert any(h.failovers == 1 for h in handles)
+    assert all(
+        h.replica_id == survivor for h in handles if h.failovers
+    )
+    reps[survivor].engine.kv.assert_consistent()
+
+
+def test_cluster_handle_timeout_and_result(lm, lm_params):
+    clock = [0.0]
+    reps = [Replica(0, make_engine(lm, lm_params),
+                    clock=lambda: clock[0])]
+    router = ReplicaRouter(reps, clock=lambda: clock[0])
+    h = router.submit(prompts_for(1)[0], 8, timeout_s=5.0)
+    router.step()
+    clock[0] = 10.0
+    router.step()
+    assert h.status == "timeout"
+    with pytest.raises(TimeoutError):
+        router.result(h)
+
+
+# ---------------------------------------------------------------------------
+# Migration: extract/restore, wire roundtrip
+# ---------------------------------------------------------------------------
+
+
+def test_migration_mid_stream_is_bit_exact(lm, lm_params):
+    """Move a live sequence to a DIFFERENTLY-SIZED pool mid-decode and
+    finish there — the stream equals the sequential oracle's."""
+    prompt = prompts_for(1, rng_seed=5)[0]
+    [want] = oracle_streams(lm, lm_params, [prompt], 8)
+
+    src = make_engine(lm, lm_params)
+    dst = make_engine(lm, lm_params, n_blocks=32)
+    sp = SamplingParams()
+    src.kv.allocate("s", len(prompt))
+    logits = src.prefill(prompt, "s")
+    toks = [src.sample(logits, sp, len(prompt))]
+    cur = len(prompt)
+    for _ in range(3):
+        src.kv.extend("s", cur + 1)
+        logits = src.decode([toks[-1]], ["s"], [cur])[0]
+        cur += 1
+        toks.append(src.sample(logits, sp, cur))
+
+    snap = extract_sequence(src, "s", context=prompt + toks[:-1])
+    assert snap.seq_len == cur and snap.n_pages > 0
+    src.kv.free("s")
+    src.kv.assert_consistent()
+
+    restore_sequence(dst, snap, "t")
+    dst.kv.assert_consistent()
+    while len(toks) < 8:
+        dst.kv.extend("t", cur + 1)
+        logits = dst.decode([toks[-1]], ["t"], [cur])[0]
+        cur += 1
+        toks.append(dst.sample(logits, sp, cur))
+    assert toks == want
+
+
+def test_restore_rejects_mismatched_geometry(lm, lm_params):
+    src = make_engine(lm, lm_params)
+    src.kv.allocate("s", 5)
+    src.prefill([1, 2, 3, 4, 5], "s")
+    snap = extract_sequence(src, "s")
+    bad = make_engine(lm, lm_params, block_size=8, n_blocks=32)
+    with pytest.raises(ValueError):
+        restore_sequence(bad, snap, "t")
+    bad.kv.assert_consistent()  # failed restore leaks nothing
+
+
+def test_snapshot_socket_roundtrip(monkeypatch):
+    """KV snapshot over a REAL loopback SocketPlane pair: typed frames,
+    dtype/shape/bit-equal pages, context intact."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_kvtransport import FakeKvClient
+
+    from chainermn_tpu.communicators import kvtransport as kvt
+    from chainermn_tpu.serving.cluster.migration import KVSnapshot
+
+    fake = FakeKvClient()
+    monkeypatch.setattr(kvt, "client", lambda: fake)
+    p0, p1 = kvt.SocketPlane(0), kvt.SocketPlane(1)
+
+    class MiniPlane:
+        """ObjectPlane-shaped shim over a raw SocketPlane."""
+
+        def __init__(self, sp, rank):
+            self.sp, self.rank, self.members = sp, rank, [0, 1]
+            self._seq = {}
+
+        def send(self, obj, dest, tag=0):
+            k = ("s", dest, tag)
+            self.sp.send("mig", dest, tag, self._seq.get(k, 0), obj)
+            self._seq[k] = self._seq.get(k, 0) + 1
+
+        def recv(self, src, tag=0, timeout_ms=None):
+            k = ("r", src, tag)
+            out = self.sp.recv("mig", src, tag, self._seq.get(k, 0),
+                               timeout_ms=timeout_ms)
+            self._seq[k] = self._seq.get(k, 0) + 1
+            return out
+
+    rng = np.random.default_rng(0)
+    snap = KVSnapshot(
+        seq_len=7, block_size=4,
+        pages=[
+            rng.standard_normal((2, 4, 2, 8)).astype(np.float32),
+            rng.standard_normal((2, 4, 2, 8)).astype(np.float32),
+        ],
+        context=[1, 2, 3, 4, 5, 6, 7],
+    )
+    got = []
+    t = threading.Thread(
+        target=lambda: got.append(
+            recv_snapshot(MiniPlane(p1, 1), 0, timeout_ms=10_000)
+        )
+    )
+    t.start()
+    send_snapshot(MiniPlane(p0, 0), 1, snap)
+    t.join(10)
+    assert got and got[0].seq_len == 7
+    assert got[0].context == snap.context
+    for a, b in zip(got[0].pages, snap.pages):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert np.array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Disaggregation: prefill role never decodes, decoders never prefill long
+# ---------------------------------------------------------------------------
+
+
+def test_disagg_prefill_decode_split(lm, lm_params):
+    long_prompt = prompts_for(1, rng_seed=9, lo=24, hi=25)[0]
+    short = prompts_for(3, rng_seed=10, lo=3, hi=6)
+    want = oracle_streams(
+        lm, lm_params, [long_prompt] + short, 8
+    )
+    reps, router = _mk_cluster(
+        lm, lm_params, n=2, roles=["prefill", "decode"],
+        prefill_threshold=10,
+    )
+    handles = [router.submit(long_prompt, 8)]
+    handles += [router.submit(p, 8) for p in short]
+    router.run_until_idle()
+    for h, w in zip(handles, want):
+        assert h.status == "finished"
+        assert h.tokens == w
+    # the long prompt decoded on the decode replica, and the prefill
+    # replica never ran a decode step
+    assert handles[0].replica_id == 1
+    assert reps[0].engine._tokens_decoded == 0
+    # short prompts bypassed the prefill tier entirely
+    assert all(h.replica_id == 1 for h in handles[1:])
+    for r in reps:
+        r.engine.kv.assert_consistent()
+
+
+def test_disagg_requeues_when_prompt_cannot_fit(lm, lm_params):
+    """A prompt larger than the prefill pool is a terminal error, not a
+    hang; one that merely doesn't fit RIGHT NOW re-queues behind the
+    pool."""
+    from chainermn_tpu.serving.cluster.disagg import (
+        PrefillJob,
+        run_prefill_job,
+    )
+
+    eng = make_engine(lm, lm_params, n_blocks=4)  # 16 token positions
+    res = run_prefill_job(eng, PrefillJob(
+        handle=0, prompt=list(range(1, 30)), sampling=SamplingParams(),
+    ))
+    assert res is not None and res.error is not None
+    # transiently full: pages held by another sequence
+    eng2 = make_engine(lm, lm_params, n_blocks=4)
+    eng2.kv.allocate("hog", 12)
+    out = run_prefill_job(eng2, PrefillJob(
+        handle=1, prompt=list(range(1, 9)), sampling=SamplingParams(),
+    ))
+    assert out is None  # requeue signal
+    eng2.kv.free("hog")
+    out = run_prefill_job(eng2, PrefillJob(
+        handle=1, prompt=list(range(1, 9)), sampling=SamplingParams(),
+    ))
+    assert out is not None and out.error is None
+    assert out.snapshot.n_pages == 2
+    eng2.kv.assert_consistent()  # scratch freed either way
+
+
+# ---------------------------------------------------------------------------
+# Health: heartbeats, scale signals, gauges
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_monitor_detects_and_revives():
+    clock = [0.0]
+    mon = HeartbeatMonitor([0, 1], miss_after_s=2.0,
+                           clock=lambda: clock[0])
+    mon.beat(0)
+    mon.beat(1)
+    clock[0] = 1.0
+    assert mon.check() == []
+    clock[0] = 2.5
+    mon.beat(1)
+    assert mon.check() == [0]       # newly dead, exactly once
+    assert mon.check() == []
+    assert not mon.alive(0) and mon.alive(1)
+    mon.beat(0)                     # replacement process beats again
+    assert mon.alive(0)
+    clock[0] = 3.0
+    assert mon.check() == []
+
+
+def test_scale_signals_pressure_and_drain(lm, lm_params):
+    reps, router = _mk_cluster(lm, lm_params, n=2)
+    sig = scale_signals(router.loads())
+    assert sig["replicas_alive"] == 2
+    assert sig["scale_up"] is False
+    # idle twin fleet: one replica is a drain candidate
+    assert sig["drain_candidate"] is not None
+    # saturate the queues → scale-up signal, no drain candidate
+    for h in range(20):
+        try:
+            router.submit(prompts_for(1)[0], 4)
+        except QueueFull:
+            break
+    sig = scale_signals(router.loads(), queue_pressure_frac=0.1)
+    assert sig["queued"] > 0
+    assert sig["drain_candidate"] is None
+    router.run_until_idle()
+
+
+def test_replica_gauges_and_prometheus_labels(lm, lm_params):
+    from chainermn_tpu.observability import Reporter
+    from chainermn_tpu.tools.obs import to_prometheus
+
+    rep = Reporter()
+    replica = Replica("r0", make_engine(lm, lm_params), reporter=rep)
+    h = replica.frontend.submit(prompts_for(1)[0], 4)
+    while not h.done:
+        replica.step()
+    g = rep.summary()["gauges"]
+    assert g["serving/running/replica/r0"]["value"] == 0
+    assert g["serving/free_blocks/replica/r0"]["value"] == 64
+    # bare names (single-engine serving) stay unsuffixed
+    assert "serving/running" not in g
+
+    summary = {"gauges": {
+        "serving/running/replica/r0": {"sum": 2.0, "max": 2.0},
+        "serving/running": {"sum": 1.0, "max": 1.0},
+    }}
+    prom = to_prometheus(summary)
+    assert ('chainermn_tpu_gauge{name="serving/running",'
+            'replica="r0"} 2' in prom)
+    assert 'chainermn_tpu_gauge{name="serving/running"} 1' in prom
+
+
+# ---------------------------------------------------------------------------
+# CLI + threaded soak
+# ---------------------------------------------------------------------------
+
+
+def test_serve_cli_local_verify_smoke():
+    from conftest import subprocess_env
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "chainermn_tpu.tools.serve",
+         "--replicas", "2", "--verify", "--requests", "4",
+         "--new-tokens", "6", "--prompt-len", "8",
+         "--vocab", "32", "--d-model", "16", "--d-ff", "32",
+         "--max-len", "64", "--block-size", "4", "--n-blocks", "32"],
+        capture_output=True, text=True, timeout=420,
+        env=subprocess_env(n_devices=1), cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.splitlines()[-1])
+    assert out["parity"] == "ok"
+    assert out["statuses"] == {"finished": 4}
+    assert out["tokens"] == 24
+
+
+def test_bench_serve_cluster_disagg_proof_smoke():
+    from conftest import subprocess_env
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--serve",
+         "--serve-replicas", "2",
+         "--lm-vocab", "32", "--lm-d-model", "16", "--lm-heads", "2",
+         "--lm-d-ff", "32", "--lm-layers", "1",
+         "--serve-batch-sizes", "2", "--serve-requests", "3",
+         "--serve-prompt-len", "6", "--serve-new-tokens", "4",
+         "--serve-block-size", "4", "--serve-blocks", "64",
+         "--serve-max-len", "64", "--serve-queue", "8"],
+        capture_output=True, text=True, timeout=420,
+        env=subprocess_env(n_devices=1), cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.splitlines()[-1])
+    # the single-engine report shape is intact...
+    assert out["unit"] == "tokens/sec" and out["value"] > 0
+    # ...and the cluster section carries the disaggregation evidence
+    cl = out["cluster"]
+    assert cl["replicas"] == 2
+    assert cl["routed"]["finished"] == cl["routed"]["requests"]
+    proof = cl["disagg_proof"]
+    assert proof["single_replica_mixed"]["finished"] == 4
+    assert proof["disaggregated"]["finished"] == 4
+    assert proof["long_prompt_len"] > 6
+
+
+def test_serving_cluster_soak_threaded_failover(lm, lm_params):
+    """Soak (auto-marked slow): threaded replicas, concurrent
+    submission, one replica killed mid-stream — every stream bit-exact
+    vs the sequential oracle, survivor invariants intact."""
+    prompts = prompts_for(10, rng_seed=21, lo=4, hi=12)
+    want = oracle_streams(lm, lm_params, prompts, 8)
+    reps = [Replica(i, make_engine(lm, lm_params), max_queue=16)
+            for i in range(3)]
+    router = ReplicaRouter(
+        reps, health=HeartbeatMonitor([0, 1, 2], miss_after_s=1e9),
+    )
+    with ThreadedClusterDriver(router) as drv:
+        handles = [router.submit(p, 8, timeout_s=120.0)
+                   for p in prompts]
+        # let some tokens commit, then kill whichever replica owns
+        # the first unfinished handle
+        while sum(len(h.tokens) for h in handles) < 5:
+            router.step(drive_replicas=False)
+        victim = next(
+            (h.replica_id for h in handles
+             if not h.done and h.replica_id is not None), 0,
+        )
+        router.fail_replica(victim, "soak kill")
+        drv.run_until_idle(timeout_s=240.0)
+    for h, w in zip(handles, want):
+        assert h.status == "finished", (h.request_id, h.status, h.error)
+        assert h.tokens == w
+    for r in reps:
+        if r.replica_id != victim:
+            r.engine.kv.assert_consistent()
