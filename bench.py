@@ -88,6 +88,25 @@ def _compiled_flops_per_device(lowerable, *args, fallback):
         return fallback
 
 
+def _allreduce_overlap(lowerable, *args):
+    """Async-pair census of the compiled step (hlo_audit): how many
+    collectives the compiler split into ``-start``/``-done`` pairs and
+    what fraction have real compute scheduled between the two — the
+    overlap the backward-staged schedule exists to expose.  Zeroes on
+    backends that never emit async pairs (CPU); None if the HLO text is
+    unavailable."""
+    try:
+        from chainermn_tpu.observability import audit_hlo_text
+
+        audit = audit_hlo_text(lowerable.lower(*args).compile().as_text())
+        return {
+            "async_pairs": audit.async_pairs,
+            "overlap_fraction": round(audit.overlap_fraction, 4),
+        }
+    except Exception:
+        return None
+
+
 def bench_resnet(comm, args):
     from chainermn_tpu.models.resnet import ResNet50
 
@@ -234,8 +253,13 @@ def bench_resnet(comm, args):
     metric = "images/sec/chip ResNet-50 ImageNet train step"
     if args.pipeline:
         metric += " (host pipeline)"
+    overlap_rec = _allreduce_overlap(
+        step, params, state, batch_stats, (x, y)
+    )
     return {
         "metric": metric,
+        "overlap": comm.resolve_overlap(),
+        "allreduce_overlap": overlap_rec,
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / REFERENCE_IMAGES_PER_SEC_PER_CHIP, 3),
@@ -373,6 +397,10 @@ def bench_lm(comm, args):
         "metric": "tokens/sec/chip decoder-LM train step "
                   "(flash attention + fused CE"
                   + (" + remat" if use_remat else "") + ", AdamW)",
+        "overlap": comm.resolve_overlap(),
+        "allreduce_overlap": _allreduce_overlap(
+            step, params, state, (tokens, labels)
+        ),
         "value": round(tok_per_chip, 1),
         "unit": "tokens/sec/chip",
         "mfu_vs_v5e_peak": round(mfu, 4),
@@ -728,13 +756,29 @@ def main(argv=None):
     ap.add_argument("--serve-queue", type=int, default=None,
                     help="bounded frontend queue size per "
                          "replica/engine (default: fits all requests)")
+    ap.add_argument("--no-overlap", action="store_true",
+                    help="pin the eager pack-all-then-reduce-all "
+                         "gradient schedule (overlap=False on the "
+                         "communicator) — the A/B lever against the "
+                         "default backward-overlapped schedule; both "
+                         "runs report allreduce_overlap (async pairs + "
+                         "overlap fraction from the compiled HLO) next "
+                         "to the step time")
     ap.add_argument("--step-log", default=None, metavar="PATH",
                     help="write a JSONL event log of the bench run "
                          "(compile events, instrumented-step spans, the "
                          "final result row); summarize with `python -m "
                          "chainermn_tpu.tools.obs summarize PATH`")
     args = ap.parse_args(argv)
-    comm = chainermn_tpu.create_communicator("xla_ici")
+    if not args.no_overlap:
+        # Seed the latency-hiding / async-collective XLA flags before the
+        # first device touch initializes the backend (no-op off-TPU).
+        from chainermn_tpu.communicators import overlap as overlap_mod
+
+        overlap_mod.ensure_overlap_flags()
+    comm = chainermn_tpu.create_communicator(
+        "xla_ici", overlap=False if args.no_overlap else None
+    )
 
     telemetry = contextlib.ExitStack()
     recorder = None

@@ -1,7 +1,10 @@
 """Seeded-violation fixtures: one deliberately broken program per rule,
-plus the clean train step none of them may flag — and one deliberately
-CLEAN program (``serving_decode``, ``expect=None``) pinning that the
-serving engine's decode step stays collective-free.
+plus the clean train step none of them may flag — and two deliberately
+CLEAN entries (``expect=None``): ``serving_decode`` pinning that the
+serving engine's decode step stays collective-free, and
+``overlap_async_pairs`` pinning that R004 reads a compiled overlapped
+schedule's ``all-reduce-start``/``-done`` pairs as ONE collective each
+instead of misdiagnosing them as a bucketing regression.
 
 These are the linter's own regression corpus — ``python -m
 chainermn_tpu.tools.lint --fixtures`` lints them (and must exit
@@ -161,6 +164,51 @@ def fixture_r005() -> dict:
     )
 
 
+#: Seeded compiled-HLO text for the async-pair fixture: a 4-bucket
+#: overlapped backward where the TPU compiler split every bucket
+#: allreduce into an ``all-reduce-start``/``all-reduce-done`` pair that
+#: straddles the remaining backward compute.  Shaped so that the
+#: UNFOLDED tally (4 starts + 4 dones = 8 ≥ 6 leaves) would trip R004's
+#: bucketing-regression threshold if the census ever double-counted the
+#: pairs again; the folded count (4 buckets < 6 leaves) is clean.
+_ASYNC_PAIR_HLO = """\
+HloModule overlapped_step
+
+ENTRY %main (p0: f32[65536], p1: f32[65536]) -> f32[65536] {
+  %p0 = f32[65536]{0} parameter(0)
+  %p1 = f32[65536]{0} parameter(1)
+  %ars0 = f32[65536]{0} all-reduce-start(%p0), replica_groups={}, to_apply=%sum
+  %bwd0 = f32[65536]{0} multiply(%p1, %p1)
+  %ars1 = f32[65536]{0} all-reduce-start(%bwd0), replica_groups={}, to_apply=%sum
+  %bwd1 = f32[65536]{0} add(%bwd0, %p0)
+  %ard0 = f32[65536]{0} all-reduce-done(%ars0)
+  %ars2 = f32[65536]{0} all-reduce-start(%bwd1), replica_groups={}, to_apply=%sum
+  %bwd2 = f32[65536]{0} multiply(%bwd1, %bwd1)
+  %ard1 = f32[65536]{0} all-reduce-done(%ars1)
+  %ard2 = f32[65536]{0} all-reduce-done(%ars2)
+  %ars3 = f32[65536]{0} all-reduce-start(%bwd2), replica_groups={}, to_apply=%sum
+  %ard3 = f32[65536]{0} all-reduce-done(%ars3)
+  ROOT %out = f32[65536]{0} add(%ard0, %ard3)
+}
+"""
+
+
+def fixture_overlap_async_pairs() -> dict:
+    """Paired-async representation (CLEAN, ``expect=None``): the census
+    of a compiled overlapped schedule, where each bucket allreduce is an
+    ``all-reduce-start``/``-done`` pair interleaved with backward
+    compute.  R004 must read the 4 pairs as 4 logical reductions — NOT 8
+    collectives ≥ the 6-leaf tree, which would misdiagnose overlap as a
+    bucketing regression (docs/performance.md, overlap section)."""
+    from chainermn_tpu.observability import audit_hlo_text
+
+    audit = audit_hlo_text(_ASYNC_PAIR_HLO)
+    return dict(
+        target="overlap_async_pairs", expect=None, audit=audit,
+        n_leaves=6, comm=None,
+    )
+
+
 def fixture_serving_decode() -> dict:
     """The serving engine's jitted single-token decode step — a CLEAN
     fixture (``expect=None``): the decode data plane must stay
@@ -209,6 +257,7 @@ FIXTURES: Dict[str, Callable[[], dict]] = {
     "r003": fixture_r003,
     "r004": fixture_r004,
     "r005": fixture_r005,
+    "overlap_async_pairs": fixture_overlap_async_pairs,
     "serving_decode": fixture_serving_decode,
 }
 

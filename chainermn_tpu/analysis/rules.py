@@ -221,7 +221,11 @@ def check_bucketing_regression(ctx: LintContext) -> List[Finding]:
     # O(n_buckets) reductions (+1 for the loss pmean); one-or-more
     # reduction *per leaf* is the unbucketed per-leaf lowering leaking
     # back in — each collective re-pays the dispatch latency the fused
-    # flat-buffer path exists to amortize.
+    # flat-buffer path exists to amortize.  Compiled-HLO audits arrive
+    # in the paired-async representation (``all-reduce-start``/``-done``
+    # per bucket under the overlapped schedule); reduction_collectives()
+    # folds each pair to ONE logical reduction, so overlap cannot be
+    # misread as a bucketing regression (fixture: overlap_async_pairs).
     if red < n_leaves:
         return []
     return [Finding(

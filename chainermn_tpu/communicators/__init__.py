@@ -34,8 +34,9 @@ from .naive import NaiveCommunicator
 from .single_host import SingleHostCommunicator, SingleNodeCommunicator
 from .two_dimensional import TwoDimensionalCommunicator
 from .xla_ici import FlatCommunicator, XlaIciCommunicator
-from . import mesh_utils, packing
+from . import mesh_utils, overlap, packing
 from .mesh_utils import build_mesh
+from .overlap import OverlapSchedule, build_overlap_schedule
 from .packing import DEFAULT_BUCKET_BYTES, GradPacker, pack_tree
 
 _COMMUNICATORS: dict[str, type[CommunicatorBase]] = {
@@ -59,6 +60,8 @@ def create_communicator(
     intra_size: int | None = None,
     bucket_bytes: int | None = None,
     scatter_inter: bool = False,
+    overlap: bool | None = None,
+    overlap_granularity: int | None = None,
 ) -> CommunicatorBase:
     """Create a communicator by name (reference signature:
     ``create_communicator(communicator_name='hierarchical', mpi_comm=None,
@@ -75,6 +78,13 @@ def create_communicator(
     cap.  ``scatter_inter`` (hierarchical only) decomposes its intra leg
     into reduce-scatter/all-gather so the inter (DCN) hop moves
     ``1/intra_size`` of the bytes.
+
+    ``overlap`` controls the backward-overlapped bucket emission
+    (:mod:`chainermn_tpu.communicators.overlap`): ``None`` resolves the
+    ``CHAINERMN_TPU_OVERLAP`` env gate (default ON), ``False`` pins the
+    eager pack-all-then-reduce-all schedule (the ``--no-overlap`` A/B in
+    bench.py).  ``overlap_granularity`` sets buckets emitted per
+    schedule stage (``None`` = env → tuned → 1).
     """
     try:
         cls = _COMMUNICATORS[communicator_name]
@@ -86,7 +96,8 @@ def create_communicator(
     if mesh is None:
         mesh = build_mesh(inter_size=inter_size, intra_size=intra_size)
     kwargs: dict = dict(
-        allreduce_grad_dtype=allreduce_grad_dtype, bucket_bytes=bucket_bytes
+        allreduce_grad_dtype=allreduce_grad_dtype, bucket_bytes=bucket_bytes,
+        overlap=overlap, overlap_granularity=overlap_granularity,
     )
     if scatter_inter:
         if not issubclass(cls, HierarchicalCommunicator):
@@ -110,8 +121,11 @@ __all__ = [
     "create_communicator",
     "build_mesh",
     "mesh_utils",
+    "overlap",
     "packing",
     "GradPacker",
+    "OverlapSchedule",
+    "build_overlap_schedule",
     "pack_tree",
     "DEFAULT_BUCKET_BYTES",
 ]

@@ -51,7 +51,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from . import kvtransport, mesh_utils, packing
+from . import kvtransport, mesh_utils, overlap as overlap_mod, packing
 
 try:  # jax >= 0.4.35
     from jax import shard_map as _shard_map_impl
@@ -141,6 +141,8 @@ class CommunicatorBase:
         allreduce_grad_dtype: Any | None = None,
         host_members: Sequence[int] | None = None,
         bucket_bytes: int | None = None,
+        overlap: bool | None = None,
+        overlap_granularity: int | None = None,
     ):
         # Subgroup membership (``split(color, key)``): the ordered GLOBAL
         # process indices participating in this communicator's host plane.
@@ -181,6 +183,24 @@ class CommunicatorBase:
                     f"bucket_bytes must be >= 0, got {bucket_bytes}"
                 )
         self.bucket_bytes = bucket_bytes
+        # Backward-overlapped bucket emission
+        # (chainermn_tpu.communicators.overlap): None = resolve at call
+        # time (CHAINERMN_TPU_OVERLAP env, default ON), True/False pins
+        # the schedule regardless of environment.
+        self.overlap = None if overlap is None else bool(overlap)
+        if overlap_granularity is not None:
+            overlap_granularity = int(overlap_granularity)
+            if overlap_granularity < 1:
+                raise ValueError(
+                    "overlap_granularity must be >= 1, got "
+                    f"{overlap_granularity}"
+                )
+        self.overlap_granularity = overlap_granularity
+        # Seed the latency-hiding-scheduler / async-collective XLA flags
+        # while they can still take effect (no-op off-TPU, after backend
+        # init, or when overlap is off — see overlap.ensure_overlap_flags).
+        if self.overlap is not False:
+            overlap_mod.ensure_overlap_flags()
         # Host-plane transport context.  Communicator construction is SPMD
         # (every process builds the same communicators in the same order —
         # the same contract MPI_Comm_create relies on), so a class-level
@@ -519,7 +539,7 @@ class CommunicatorBase:
         """
         return jax.tree.map(lambda x: self.bcast(x, root), tree)
 
-    def allreduce_grad(self, tree):
+    def allreduce_grad(self, tree, overlap: bool | None = None):
         """Average a gradient pytree across the communicator's world.
 
         Reference: ``CommunicatorBase.allreduce_grad(model)`` — divides by
@@ -533,6 +553,14 @@ class CommunicatorBase:
         Single-leaf trees take the direct path unchanged, and
         ``bucket_bytes=0`` (or ``CHAINERMN_TPU_BUCKET_BYTES=0``) restores
         the legacy unbucketed lowering.
+
+        ``overlap`` pins the emission schedule for THIS call (the staged
+        train-step pipeline threads it); ``None`` resolves ctor ->
+        ``CHAINERMN_TPU_OVERLAP`` -> ON.  Overlapped emission is
+        bit-exact vs eager: same per-bucket collectives, same operands —
+        only the trace order changes so the buckets whose gradients the
+        backward pass produces FIRST reduce while the rest still compute
+        (see :mod:`chainermn_tpu.communicators.overlap`).
         """
         leaves = jax.tree.leaves(tree)
         if not leaves:
@@ -541,7 +569,7 @@ class CommunicatorBase:
         tree = _tree_cast(tree, self.allreduce_grad_dtype)
         bb = self.resolve_bucket_bytes(tree) if len(leaves) > 1 else 0
         if bb > 0:
-            out = self._allreduce_bucketed(tree, bb)
+            out = self._allreduce_bucketed(tree, bb, overlap=overlap)
         else:
             out = self._allreduce_impl(tree)
         return jax.tree.map(
@@ -593,19 +621,98 @@ class CommunicatorBase:
             communicator=self.name,
         )
 
-    def _allreduce_bucketed(self, tree, bucket_bytes: int):
+    def resolve_overlap(self, overlap: bool | None = None) -> bool:
+        """Effective overlap switch for one ``allreduce_grad`` call:
+        the call-site pin if given, else the constructor's ``overlap``,
+        else the ``CHAINERMN_TPU_OVERLAP`` environment gate (default
+        ON — ``0`` is the escape hatch)."""
+        if overlap is not None:
+            return bool(overlap)
+        if self.overlap is not None:
+            return self.overlap
+        return overlap_mod.overlap_enabled()
+
+    def resolve_overlap_granularity(self, tree=None) -> int:
+        """Effective schedule granularity (buckets emitted per stage).
+
+        Resolution order mirrors :meth:`resolve_bucket_bytes`: ctor ->
+        ``CHAINERMN_TPU_OVERLAP_GRANULARITY`` env -> tuned value (TPU
+        runtime only) -> 1 (finest overlap: one collective per stage).
+        """
+        if self.overlap_granularity is not None:
+            return self.overlap_granularity
+        raw = os.environ.get(overlap_mod.ENV_OVERLAP_GRANULARITY, "").strip()
+        if raw:
+            try:
+                return max(1, int(raw))
+            except ValueError:
+                pass
+        if tree is not None:
+            tuned = self._tuned_overlap_granularity(tree)
+            if tuned is not None:
+                return max(1, int(tuned))
+        return overlap_mod.DEFAULT_GRANULARITY
+
+    def _tuned_overlap_granularity(self, tree):
+        try:
+            from chainermn_tpu.tuning.autotune import lookup_overlap_schedule
+        except Exception:  # pragma: no cover - tuning subsystem absent
+            return None
+        leaves = jax.tree.leaves(tree)
+        per_dtype: dict = {}
+        for l in leaves:
+            dt = np.dtype(l.dtype)
+            per_dtype[dt] = per_dtype.get(dt, 0) + int(l.size) * dt.itemsize
+        dominant = max(per_dtype, key=per_dtype.get)
+        cfg = lookup_overlap_schedule(
+            total_bytes=sum(per_dtype.values()),
+            n_leaves=len(leaves),
+            dtype=dominant,
+            communicator=self.name,
+        )
+        return None if cfg is None else cfg.get("granularity")
+
+    def _allreduce_bucketed(self, tree, bucket_bytes: int,
+                            overlap: bool | None = None):
         """One characteristic ``_allreduce_impl`` per contiguous per-dtype
         bucket.  Pack/unpack are pure layout moves (ravel/concat/slice),
         so they commute exactly with the elementwise-linear collectives
         every subclass lowers to — bucketed and unbucketed results are
-        identical up to the collective's own dtype arithmetic."""
+        identical up to the collective's own dtype arithmetic.
+
+        Two emission schedules, numerically identical:
+
+        * **overlapped** (default): per-bucket pack + collective in
+          reverse leaf-production order (`overlap.build_overlap_schedule`)
+          so each collective's operands are exactly its member leaves and
+          the first-ready buckets reduce under the rest of the backward
+          pass (async start/done pairs straddle compute in the HLO once
+          the latency-hiding scheduler runs).
+        * **eager** (``CHAINERMN_TPU_OVERLAP=0``): pack every bucket,
+          then reduce every bucket — the pre-overlap lowering, kept as
+          the escape hatch and the parity oracle.
+        """
         packer = packing.GradPacker.for_tree(tree, bucket_bytes=bucket_bytes)
         self._report_packing(packer)
         from chainermn_tpu.observability.spans import named_scope
 
-        with named_scope("grad-pack"):
-            bufs = packer.pack(tree)
-        outs = [self._allreduce_impl(b) for b in bufs]
+        if not self.resolve_overlap(overlap):
+            with named_scope("grad-pack"):
+                bufs = packer.pack(tree)
+            outs = [self._allreduce_impl(b) for b in bufs]
+            with named_scope("grad-unpack"):
+                return packer.unpack(outs)
+
+        schedule = overlap_mod.build_overlap_schedule(
+            packer, self.resolve_overlap_granularity(tree)
+        )
+        leaves = packer._check_tree(tree)
+        outs: list = [None] * packer.n_buckets
+        for s, stage in enumerate(schedule.stages):
+            with named_scope(f"grad-stage{s}"):
+                bufs = [packer.pack_bucket(leaves, i) for i in stage]
+                for i, buf in zip(stage, bufs):
+                    outs[i] = self._allreduce_impl(buf)
         with named_scope("grad-unpack"):
             return packer.unpack(outs)
 
@@ -984,6 +1091,8 @@ class CommunicatorBase:
                 allreduce_grad_dtype=self.allreduce_grad_dtype,
                 host_members=self._hp_members,
                 bucket_bytes=self.bucket_bytes,
+                overlap=self.overlap,
+                overlap_granularity=self.overlap_granularity,
             )
         except ValueError:
             CommunicatorBase._plane_count = count
@@ -994,6 +1103,8 @@ class CommunicatorBase:
                 allreduce_grad_dtype=self.allreduce_grad_dtype,
                 host_members=self._hp_members,
                 bucket_bytes=self.bucket_bytes,
+                overlap=self.overlap,
+                overlap_granularity=self.overlap_granularity,
             )
 
     def split_devices(self, colors, keys=None) -> dict:
@@ -1064,6 +1175,8 @@ class CommunicatorBase:
                 allreduce_grad_dtype=self.allreduce_grad_dtype,
                 host_members=procs,
                 bucket_bytes=self.bucket_bytes,
+                overlap=self.overlap,
+                overlap_granularity=self.overlap_granularity,
             )
         return out
 
@@ -1129,6 +1242,8 @@ class CommunicatorBase:
                 allreduce_grad_dtype=self.allreduce_grad_dtype,
                 host_members=members,
                 bucket_bytes=self.bucket_bytes,
+                overlap=self.overlap,
+                overlap_granularity=self.overlap_granularity,
             )
         except ValueError:
             CommunicatorBase._plane_count = count
@@ -1139,6 +1254,8 @@ class CommunicatorBase:
                 allreduce_grad_dtype=self.allreduce_grad_dtype,
                 host_members=members,
                 bucket_bytes=self.bucket_bytes,
+                overlap=self.overlap,
+                overlap_granularity=self.overlap_granularity,
             )
 
     def __repr__(self):

@@ -43,10 +43,13 @@ class HierarchicalCommunicator(CommunicatorBase):
 
     def __init__(self, mesh=None, axes=None, allreduce_grad_dtype=None,
                  host_members=None, bucket_bytes=None,
+                 overlap=None, overlap_granularity=None,
                  scatter_inter: bool = False):
         super().__init__(mesh, axes, allreduce_grad_dtype,
                          host_members=host_members,
-                         bucket_bytes=bucket_bytes)
+                         bucket_bytes=bucket_bytes,
+                         overlap=overlap,
+                         overlap_granularity=overlap_granularity)
         if mesh_utils.AXIS_INTRA not in self.axes or mesh_utils.AXIS_INTER not in self.axes:
             raise ValueError(
                 "hierarchical communicator needs both 'inter' and 'intra' "

@@ -253,14 +253,25 @@ class GradPacker:
     def pack(self, tree) -> List[jax.Array]:
         """Pytree → one 1-D buffer per bucket (padded with zeros)."""
         leaves = self._check_tree(tree)
-        bufs = []
-        for b in self.buckets:
-            parts = [jnp.ravel(leaves[i]) for i in b.leaf_indices]
-            pad = b.padded_elems - b.elems
-            if pad:
-                parts.append(jnp.zeros((pad,), dtype=b.dtype))
-            bufs.append(parts[0] if len(parts) == 1 else jnp.concatenate(parts))
-        return bufs
+        return [self.pack_bucket(leaves, i) for i in range(self.n_buckets)]
+
+    def pack_bucket(self, leaves: Sequence[jax.Array], index: int) -> jax.Array:
+        """One bucket's buffer from the tree's flattened leaves.
+
+        The per-bucket form of :meth:`pack` the overlapped emission
+        schedule (:mod:`chainermn_tpu.communicators.overlap`) uses:
+        packing bucket-by-bucket keeps each collective's dependence
+        frontier at exactly its member leaves, so the compiler may start
+        it while other leaves' gradients are still being produced.
+        ``leaves`` must already be in the plan's flatten order (use
+        :meth:`_check_tree` / ``jax.tree.flatten`` on the full tree).
+        """
+        b = self.buckets[index]
+        parts = [jnp.ravel(leaves[i]) for i in b.leaf_indices]
+        pad = b.padded_elems - b.elems
+        if pad:
+            parts.append(jnp.zeros((pad,), dtype=b.dtype))
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
 
     def unpack(self, bufs: Sequence[jax.Array]):
         """Bucket buffers → pytree (inverse of :meth:`pack`; padding is

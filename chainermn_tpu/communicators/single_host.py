@@ -21,10 +21,13 @@ class SingleHostCommunicator(CommunicatorBase):
     name = "single_host"
 
     def __init__(self, mesh=None, axes=None, allreduce_grad_dtype=None,
-                 host_members=None, bucket_bytes=None):
+                 host_members=None, bucket_bytes=None,
+                 overlap=None, overlap_granularity=None):
         super().__init__(mesh, axes, allreduce_grad_dtype,
                          host_members=host_members,
-                         bucket_bytes=bucket_bytes)
+                         bucket_bytes=bucket_bytes,
+                         overlap=overlap,
+                         overlap_granularity=overlap_granularity)
         if self.inter_size != 1 and mesh_utils.AXIS_INTER in self.axes:
             raise ValueError(
                 "single_host communicator requires inter_size == 1 "

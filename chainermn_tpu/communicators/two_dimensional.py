@@ -33,10 +33,13 @@ class TwoDimensionalCommunicator(CommunicatorBase):
     name = "two_dimensional"
 
     def __init__(self, mesh=None, axes=None, allreduce_grad_dtype=None,
-                 host_members=None, bucket_bytes=None):
+                 host_members=None, bucket_bytes=None,
+                 overlap=None, overlap_granularity=None):
         super().__init__(mesh, axes, allreduce_grad_dtype,
                          host_members=host_members,
-                         bucket_bytes=bucket_bytes)
+                         bucket_bytes=bucket_bytes,
+                         overlap=overlap,
+                         overlap_granularity=overlap_granularity)
         if mesh_utils.AXIS_INTRA not in self.axes or mesh_utils.AXIS_INTER not in self.axes:
             raise ValueError(
                 "two_dimensional communicator needs both 'inter' and 'intra' "

@@ -41,8 +41,11 @@ from chainermn_tpu.observability.hlo_audit import (  # noqa: F401
     TracedStep,
     audit_allreduce,
     audit_allreduce_tree,
+    audit_compiled,
     audit_fn,
+    audit_hlo_text,
     audit_jaxpr,
+    fold_async_counts,
     trace_step,
 )
 from chainermn_tpu.observability.spans import (  # noqa: F401

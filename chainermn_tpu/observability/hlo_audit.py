@@ -1,4 +1,4 @@
-"""Collective audit — jaxpr-level census of a step function's wire cost.
+"""Collective audit — jaxpr- and HLO-level census of a step's wire cost.
 
 This generalizes what ``benchmarks/allreduce_bench.py`` grew ad hoc: for
 any traceable function (a jitted train step, a communicator's
@@ -15,11 +15,29 @@ inter-axis bytes must be the flat backend's divided by ``intra_size``).
 source of truth for the bytes-per-leg metric); examples call
 :func:`audit_fn` on their real train step and log the result as an
 ``hlo_audit`` row in the step-event log.
+
+Two census sources, one :class:`CollectiveAudit` shape:
+
+* :func:`audit_jaxpr` (and the ``audit_*`` wrappers) — the traced
+  program, where collectives are single primitives (``psum``, …).
+* :func:`audit_hlo_text` — compiled HLO, where the TPU compiler's
+  async-collective machinery may have SPLIT a collective into an
+  ``all-reduce-start``/``all-reduce-done`` pair (likewise
+  ``collective-permute-start/done``, ``all-gather-start/done``) so the
+  latency-hiding scheduler can place independent backward compute
+  between the two halves — the lowering the backward-overlapped bucket
+  schedule (:mod:`chainermn_tpu.communicators.overlap`) exists to
+  trigger.  The HLO parser folds each start/done pair into ONE logical
+  collective under its jaxpr-primitive name (so
+  ``reduction_collectives()`` and ``census()`` never double-count) and
+  reports ``overlap_fraction``: the fraction of async pairs with real
+  compute scheduled strictly between start and done.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -37,6 +55,45 @@ REDUCTION_PRIMITIVES = ("psum", "reduce_scatter")
 # The four the gradient-allreduce census reports (all_to_all never appears
 # in an allreduce lowering; kept out for byte-identical bench output).
 ALLREDUCE_CENSUS_KEYS = ("psum", "reduce_scatter", "all_gather", "ppermute")
+
+#: HLO opcode → jaxpr primitive name, the vocabulary bridge that lets an
+#: HLO-text census reuse every count consumer (``census()``,
+#: ``reduction_collectives()``, lint R004) unchanged.
+HLO_COLLECTIVE_OPS = {
+    "all-reduce": "psum",
+    "reduce-scatter": "reduce_scatter",
+    "all-gather": "all_gather",
+    "collective-permute": "ppermute",
+    "all-to-all": "all_to_all",
+}
+
+_ASYNC_START = "-start"
+_ASYNC_DONE = "-done"
+
+
+def fold_async_counts(counts: Dict[str, int]) -> Dict[str, int]:
+    """Fold a counts dict that may contain RAW HLO opcodes — including
+    unpaired ``*-start``/``*-done`` entries — into jaxpr-primitive
+    counts, one logical collective per async pair.
+
+    ``-start`` carries the count (each pair has exactly one), ``-done``
+    is dropped, synchronous HLO opcodes map through
+    :data:`HLO_COLLECTIVE_OPS`, and names already in jaxpr vocabulary
+    pass unchanged.  This is the defensive normalization lint R004 runs
+    before comparing collective counts to leaf counts, so a census fed
+    from compiled HLO can never make split collectives look like a
+    bucketing regression.
+    """
+    out: Dict[str, int] = {}
+    for name, n in counts.items():
+        base = name
+        if base.endswith(_ASYNC_DONE):
+            continue
+        if base.endswith(_ASYNC_START):
+            base = base[: -len(_ASYNC_START)]
+        base = HLO_COLLECTIVE_OPS.get(base, base)
+        out[base] = out.get(base, 0) + int(n)
+    return out
 
 
 def _eqn_axes(eqn):
@@ -96,22 +153,37 @@ class CollectiveAudit:
     ``op_bytes`` — per-device operand bytes of each individual occurrence,
     in trace order per primitive: with gradient bucketing this IS the
     per-bucket byte profile of the allreduce.
+    ``async_pairs`` — start/done pairs folded into the counts (HLO-text
+    audits only; a jaxpr audit never sees the split representation).
+    ``overlap_fraction`` — fraction of those pairs with at least one
+    real compute instruction scheduled strictly between start and done:
+    the audit's measure of how much of the collective actually hides
+    under backward compute (0.0 when there are no async pairs).
     """
 
     counts: Dict[str, int]
     bytes_per_axis: Dict[str, int]
     bytes_per_primitive: Dict[str, int]
     op_bytes: Dict[str, List[int]] = dataclasses.field(default_factory=dict)
+    async_pairs: int = 0
+    overlap_fraction: float = 0.0
 
     def census(self, keys=ALLREDUCE_CENSUS_KEYS) -> Dict[str, int]:
         """Fixed-key count view (zeros included) — the allreduce-bench
-        ``hlo_collectives`` record shape."""
-        return {k: self.counts.get(k, 0) for k in keys}
+        ``hlo_collectives`` record shape.  Counts are normalized through
+        :func:`fold_async_counts`, so an audit built from raw HLO
+        opcodes (async pairs included) reports one logical collective
+        per pair."""
+        folded = fold_async_counts(self.counts)
+        return {k: folded.get(k, 0) for k in keys}
 
     def reduction_collectives(self) -> int:
         """Total reduction-collective occurrences (psum + reduce_scatter)
-        — the count bucketing makes O(n_buckets) instead of O(n_leaves)."""
-        return sum(self.counts.get(k, 0) for k in REDUCTION_PRIMITIVES)
+        — the count bucketing makes O(n_buckets) instead of O(n_leaves).
+        An ``all-reduce-start``/``all-reduce-done`` pair is ONE
+        occurrence (:func:`fold_async_counts`)."""
+        folded = fold_async_counts(self.counts)
+        return sum(folded.get(k, 0) for k in REDUCTION_PRIMITIVES)
 
     def summary(self) -> dict:
         return {
@@ -120,6 +192,8 @@ class CollectiveAudit:
             "bytes_per_primitive": dict(self.bytes_per_primitive),
             "op_bytes": {k: list(v) for k, v in self.op_bytes.items()},
             "reduction_collectives": self.reduction_collectives(),
+            "async_pairs": self.async_pairs,
+            "overlap_fraction": self.overlap_fraction,
         }
 
 
@@ -142,6 +216,197 @@ def audit_jaxpr(jaxpr) -> CollectiveAudit:
         for ax in _eqn_axes(eqn):
             per_axis[str(ax)] = per_axis.get(str(ax), 0) + nbytes
     return CollectiveAudit(counts, per_axis, per_prim, op_bytes)
+
+
+# ---------------------------------------------------------------------------
+# HLO-text census — the post-compilation view, where async collectives
+# appear as start/done pairs the jaxpr never contains.
+# ---------------------------------------------------------------------------
+
+#: HLO element type → itemsize, for payload bytes parsed out of HLO text.
+_HLO_ITEMSIZE = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "f8e4m3fn": 1, "f8e5m2": 1, "c64": 8, "c128": 16,
+}
+
+#: Instructions that are pure plumbing — NOT evidence of compute between
+#: an async start and its done (the scheduler moving a tuple or a
+#: parameter between the halves hides nothing).
+_HLO_NONCOMPUTE = frozenset((
+    "parameter", "constant", "tuple", "get-tuple-element", "bitcast",
+    "bitcast-convert", "copy", "copy-start", "copy-done", "after-all",
+    "partition-id", "replica-id", "opt-barrier", "domain",
+))
+
+_HLO_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*(?P<rest>.+)$"
+)
+_HLO_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+
+class _HloInstr(NamedTuple):
+    index: int
+    name: str
+    opcode: str
+    operands: Tuple[str, ...]
+    nbytes: int
+
+
+def _hlo_shape_bytes(type_str: str) -> int:
+    """Payload bytes of the FIRST array shape in an HLO type string —
+    for a collective's result type this is the buffer it moves (async
+    start tuples repeat the same buffer shape)."""
+    m = _HLO_SHAPE_RE.search(type_str)
+    if not m:
+        return 0
+    itemsize = _HLO_ITEMSIZE.get(m.group(1))
+    if itemsize is None:
+        return 0
+    dims = m.group(2)
+    elems = 1
+    for d in dims.split(","):
+        if d.strip():
+            elems *= int(d)
+    return elems * itemsize
+
+
+def _parse_hlo_instr(index: int, line: str) -> Optional[_HloInstr]:
+    m = _HLO_INSTR_RE.match(line)
+    if m is None:
+        return None
+    rest = m.group("rest").lstrip()
+    # Skip the result type: either one balanced-paren tuple type or a
+    # single array/scalar token; the opcode follows immediately.
+    type_str = rest
+    if rest.startswith("("):
+        depth = 0
+        for i, ch in enumerate(rest):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    type_str, rest = rest[: i + 1], rest[i + 1:].lstrip()
+                    break
+        else:
+            return None
+    else:
+        parts = rest.split(None, 1)
+        if len(parts) < 2:
+            return None
+        type_str, rest = parts[0], parts[1]
+    om = re.match(r"([a-zA-Z][\w\-]*)\s*\(", rest)
+    if om is None:
+        return None
+    return _HloInstr(
+        index=index,
+        name=m.group("name"),
+        opcode=om.group(1),
+        operands=tuple(re.findall(r"%([\w.\-]+)", rest)),
+        nbytes=_hlo_shape_bytes(type_str),
+    )
+
+
+def audit_hlo_text(hlo_text: str) -> CollectiveAudit:
+    """Census of compiled HLO text (``jitted.lower(...).compile()
+    .as_text()``), the representation where the TPU compiler's async
+    machinery splits collectives into start/done pairs.
+
+    Folding rule: an ``X-start``/``X-done`` pair is ONE logical ``X``
+    (counted under the jaxpr-primitive name via
+    :data:`HLO_COLLECTIVE_OPS`), with the pair tallied in
+    ``async_pairs``; an unmatched ``-start`` still counts once (the
+    collective exists) and an unmatched ``-done`` never does.
+    ``overlap_fraction`` is the fraction of matched pairs with at least
+    one non-plumbing instruction scheduled strictly between start and
+    done — the post-scheduler evidence that gradient collectives hide
+    under backward compute.  ``bytes_per_axis`` stays empty (HLO has
+    replica groups, not mesh-axis names); per-collective payload bytes
+    land in ``op_bytes``/``bytes_per_primitive`` as usual.
+    """
+    instrs: List[_HloInstr] = []
+    by_name: Dict[str, _HloInstr] = {}
+    for i, line in enumerate(hlo_text.splitlines()):
+        ins = _parse_hlo_instr(i, line)
+        if ins is not None:
+            instrs.append(ins)
+            by_name[ins.name] = ins
+
+    counts: Dict[str, int] = {}
+    per_prim: Dict[str, int] = {}
+    op_bytes: Dict[str, List[int]] = {}
+    async_pairs = 0
+    overlapped = 0
+    consumed_dones = set()
+
+    def _tally(prim: str, nbytes: int) -> None:
+        counts[prim] = counts.get(prim, 0) + 1
+        per_prim[prim] = per_prim.get(prim, 0) + nbytes
+        op_bytes.setdefault(prim, []).append(nbytes)
+
+    # Pair dones with their starts first (done references the start's
+    # result by name), so the start-side walk knows which are paired.
+    start_to_done: Dict[str, _HloInstr] = {}
+    for ins in instrs:
+        if not ins.opcode.endswith(_ASYNC_DONE):
+            continue
+        base = ins.opcode[: -len(_ASYNC_DONE)]
+        if base not in HLO_COLLECTIVE_OPS:
+            continue
+        for operand in ins.operands:
+            src = by_name.get(operand)
+            if src is not None and src.opcode == base + _ASYNC_START:
+                start_to_done[src.name] = ins
+                consumed_dones.add(ins.name)
+                break
+
+    for ins in instrs:
+        op = ins.opcode
+        if op in HLO_COLLECTIVE_OPS:
+            _tally(HLO_COLLECTIVE_OPS[op], ins.nbytes)
+            continue
+        if op.endswith(_ASYNC_START):
+            base = op[: -len(_ASYNC_START)]
+            if base not in HLO_COLLECTIVE_OPS:
+                continue
+            _tally(HLO_COLLECTIVE_OPS[base], ins.nbytes)
+            done = start_to_done.get(ins.name)
+            if done is None:
+                continue
+            async_pairs += 1
+            between = (
+                other for other in instrs
+                if ins.index < other.index < done.index
+            )
+            if any(
+                o.opcode not in _HLO_NONCOMPUTE
+                and o.opcode not in HLO_COLLECTIVE_OPS
+                and not o.opcode.endswith((_ASYNC_START, _ASYNC_DONE))
+                for o in between
+            ):
+                overlapped += 1
+    return CollectiveAudit(
+        counts=counts,
+        bytes_per_axis={},
+        bytes_per_primitive=per_prim,
+        op_bytes=op_bytes,
+        async_pairs=async_pairs,
+        overlap_fraction=(overlapped / async_pairs) if async_pairs else 0.0,
+    )
+
+
+def audit_compiled(fn, *args, **kwargs) -> CollectiveAudit:
+    """Compile ``fn(*args, **kwargs)`` (jitted or plain) and audit the
+    OPTIMIZED HLO — the only level where async start/done pairs and the
+    latency-hiding schedule are visible.  Args may be real arrays or
+    ``jax.ShapeDtypeStruct``s; nothing executes.  This is what
+    ``bench.py`` reports its ``overlap_fraction`` from."""
+    import jax
+
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    compiled = jitted.lower(*args, **kwargs).compile()
+    return audit_hlo_text(compiled.as_text())
 
 
 class TracedStep(NamedTuple):

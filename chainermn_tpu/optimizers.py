@@ -82,8 +82,9 @@ def _instrument_step(step_fn):
         return out
 
     # Keep jit's AOT surface reachable (bench.py lowers the step for
-    # XLA's cost model); plain-function steps just skip this.
-    for attr in ("lower", "eval_shape", "trace"):
+    # XLA's cost model; the recompile-count guard reads _cache_size);
+    # plain-function steps just skip this.
+    for attr in ("lower", "eval_shape", "trace", "_cache_size"):
         if hasattr(step_fn, attr):
             setattr(instrumented, attr, getattr(step_fn, attr))
     return instrumented
@@ -148,7 +149,7 @@ def _lint_hook(step_fn, comm):
             _run_first_call_lint(step_fn, comm, mode, args, kwargs)
         return step_fn(*args, **kwargs)
 
-    for attr in ("lower", "eval_shape", "trace"):
+    for attr in ("lower", "eval_shape", "trace", "_cache_size"):
         if hasattr(step_fn, attr):
             setattr(linted, attr, getattr(step_fn, attr))
     return linted
@@ -459,7 +460,8 @@ class MultiNodeOptimizer:
             grads = jax.tree.map(lambda g: g / n_accum, gacc)
             return lsum / n_accum, auxs, grads
 
-    def _apply_update(self, params, state, grads, loss_scale=None):
+    def _apply_update(self, params, state, grads, loss_scale=None,
+                      overlap=None):
         """Allreduce local grads and apply the inner optimizer — the shared
         tail of the stage-0 step bodies.
 
@@ -468,12 +470,21 @@ class MultiNodeOptimizer:
         _DoubleBufferingOptimizer), skipping the inner update entirely on
         step 0.  Scaled gradients (``loss_scale``) are unscaled exactly
         once, at application time.
+
+        ``overlap`` pins the communicator's staged bucket emission for this
+        step (``None`` defers to ctor/env — see
+        :meth:`CommunicatorBase.allreduce_grad`): when on, each bucket's
+        pack+allreduce is emitted as its last grad leaf becomes available
+        (reverse leaf-production order), generalizing the double-buffering
+        idea — instead of hiding the whole allreduce behind the *next*
+        step's compute at one-step staleness, buckets hide behind *this*
+        step's remaining backward compute with no staleness at all.
         """
         comm = self.communicator
         opt = self.actual_optimizer
         if self.double_buffering:
             with named_scope("allreduce"):
-                new_mean = comm.allreduce_grad(grads)
+                new_mean = comm.allreduce_grad(grads, overlap=overlap)
             stale = state.comm_buf
 
             def do_update(operand):
@@ -494,7 +505,7 @@ class MultiNodeOptimizer:
                 inner=inner, step=state.step + 1, comm_buf=new_mean
             )
         with named_scope("allreduce"):
-            grads = comm.allreduce_grad(grads)
+            grads = comm.allreduce_grad(grads, overlap=overlap)
         if loss_scale is not None:
             grads = jax.tree.map(lambda g: g / loss_scale, grads)
         with named_scope("opt-update"):
@@ -587,6 +598,7 @@ class MultiNodeOptimizer:
         rng: Any = None,
         n_accum: int = 1,
         loss_scale: float | None = None,
+        overlap: bool | None = None,
     ):
         """Build the jitted SPMD training step.
 
@@ -608,6 +620,15 @@ class MultiNodeOptimizer:
         ``loss_scale`` multiplies the loss before differentiation and
         unscales gradients after communication — parity knob for fp16-style
         mixed precision (bf16, the TPU default, does not need it).
+
+        ``overlap`` pins the staged bucket/allreduce pipeline for this
+        step: buckets are emitted in reverse leaf-production order so each
+        ``all-reduce-start`` can straddle the remaining backward compute
+        (XLA async collectives + the latency-hiding scheduler).  ``None``
+        (default) resolves communicator ctor → ``CHAINERMN_TPU_OVERLAP``
+        env (default ON); ``False`` forces the eager pack-all-then-reduce
+        schedule.  Bit-exact either way.  ZeRO steps reduce-scatter one
+        flat shard and have nothing to stage, so the knob is inert there.
 
         Returns ``step(params, state, batch) -> (params, state, loss[, aux])``.
         """
@@ -634,7 +655,7 @@ class MultiNodeOptimizer:
             )
             loss = lax.pmean(loss, axes)
             params, new_state = self._apply_update(
-                params, state, grads, loss_scale
+                params, state, grads, loss_scale, overlap=overlap
             )
             if has_aux:
                 return params, new_state, loss, aux
@@ -655,6 +676,8 @@ class MultiNodeOptimizer:
             _check_batch_divisibility(batch, n_dev, n_accum)
             return jitted(params, state, batch)
 
+        if hasattr(jitted, "_cache_size"):
+            step._cache_size = jitted._cache_size
         return self._finalize_step(step)
 
     def _scatter_grads(self, grads, shard_size, n, world):
@@ -845,9 +868,14 @@ class MultiNodeOptimizer:
         loss_fn: Callable,
         batch_spec=None,
         donate: bool = True,
+        overlap: bool | None = None,
     ):
         """Like :meth:`make_train_step` for models with non-trainable mutable
         state (BatchNorm statistics etc. — flax's ``batch_stats``).
+
+        ``overlap`` pins the staged bucket/allreduce pipeline exactly as in
+        :meth:`make_train_step` (``None`` = ctor → env, default ON;
+        bit-exact either way; inert for ZeRO).
 
         ``loss_fn(params, model_state, batch) -> (loss, new_model_state)``.
         The new model state is ``pmean``-synchronized across the world —
@@ -896,7 +924,9 @@ class MultiNodeOptimizer:
             loss, new_model_state, grads = grads_and_state(
                 params, model_state, batch
             )
-            params, new_state = self._apply_update(params, state, grads)
+            params, new_state = self._apply_update(
+                params, state, grads, overlap=overlap
+            )
             return params, new_state, new_model_state, loss
 
         mapped = comm.shard_map(

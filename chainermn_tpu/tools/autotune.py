@@ -78,12 +78,19 @@ def main(argv=None) -> int:
                     help="synthetic gradient tree size in MiB")
     ap.add_argument("--ab-leaves", type=int, default=64,
                     help="synthetic gradient tree leaf count")
+    # Backward-overlap schedule (docs/performance.md "Backward-overlapped
+    # allreduce") — shares the --ab-* tree-family flags.
+    ap.add_argument("--overlap-schedule", action="store_true",
+                    help="also tune the backward-overlap schedule "
+                         "(stage granularity x bucket_bytes; "
+                         "communicators/overlap.py)")
     args = ap.parse_args(argv)
 
     from chainermn_tpu.tuning import (
         TuneCache,
         tune_allreduce_bucket,
         tune_lm_shapes,
+        tune_overlap_schedule,
     )
 
     log = None if args.quiet else (lambda m: print(m, file=sys.stderr))
@@ -119,6 +126,14 @@ def main(argv=None) -> int:
             repeats=args.repeats, log=log,
         )
         print(json.dumps({"allreduce_bucket": rec}))
+    if args.overlap_schedule:
+        rec = tune_overlap_schedule(
+            communicator=args.ab_communicator, total_mb=args.ab_total_mb,
+            n_leaves=args.ab_leaves, dtype=args.dtype, cache=cache,
+            force=args.force, dry_run=args.dry_run, n1=args.n1,
+            repeats=args.repeats, log=log,
+        )
+        print(json.dumps({"overlap_schedule": rec}))
     return 0
 
 

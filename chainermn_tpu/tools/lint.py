@@ -52,16 +52,23 @@ def _split_csv(raw: Optional[str]) -> Optional[List[str]]:
 
 
 def _lint_one(target: dict, rules, disable) -> dict:
-    from chainermn_tpu.analysis import analyze_fn
+    from chainermn_tpu.analysis import analyze_fn, analyze_jaxpr
 
-    report = analyze_fn(
-        target["fn"], *target.get("args", ()),
-        comm=target.get("comm"), rules=rules, disable=disable or (),
-        **target.get("kwargs", {}),
-    )
+    if "audit" in target:  # pre-computed census (compiled-HLO fixtures)
+        report = analyze_jaxpr(
+            target["audit"], comm=target.get("comm"), rules=rules,
+            disable=disable or (), n_leaves=target.get("n_leaves"),
+        )
+        default_name = "<audit>"
+    else:
+        report = analyze_fn(
+            target["fn"], *target.get("args", ()),
+            comm=target.get("comm"), rules=rules, disable=disable or (),
+            **target.get("kwargs", {}),
+        )
+        default_name = getattr(target["fn"], "__name__", "<fn>")
     return {
-        "target": target.get("target", getattr(
-            target["fn"], "__name__", "<fn>")),
+        "target": target.get("target", default_name),
         "expect": target.get("expect"),
         **report.summary(),
     }

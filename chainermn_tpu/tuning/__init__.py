@@ -51,15 +51,19 @@ from chainermn_tpu.tuning.search_space import (  # noqa: F401
     decode_search_space,
     flash_cache_key,
     flash_search_space,
+    overlap_cache_key,
+    overlap_schedule_search_space,
 )
 from chainermn_tpu.tuning.autotune import (  # noqa: F401
     lookup_bucket_bytes,
     lookup_ce_chunk,
     lookup_decode_block_ctx,
     lookup_flash_blocks,
+    lookup_overlap_schedule,
     tune_allreduce_bucket,
     tune_decode_attention,
     tune_flash,
     tune_fused_ce,
     tune_lm_shapes,
+    tune_overlap_schedule,
 )

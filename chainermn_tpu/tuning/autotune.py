@@ -36,6 +36,8 @@ from chainermn_tpu.tuning.search_space import (
     flash_cache_key,
     flash_default_config,
     flash_search_space,
+    overlap_cache_key,
+    overlap_schedule_search_space,
 )
 
 
@@ -120,6 +122,30 @@ def lookup_bucket_bytes(*, total_bytes: int, n_leaves: int, dtype,
     except Exception:
         return None
     return bb if bb >= 0 else None
+
+
+def lookup_overlap_schedule(*, total_bytes: int, n_leaves: int, dtype,
+                            communicator: str) -> Optional[dict]:
+    """Tuned overlap schedule (``{"granularity", "bucket_bytes"}``) for
+    one (tree size, leaf count, dominant dtype, communicator) family, or
+    None (miss / disabled).  Consulted by the communicators'
+    ``resolve_overlap_granularity`` at trace time, after the ctor and
+    ``CHAINERMN_TPU_OVERLAP_GRANULARITY`` env overrides."""
+    if not runtime_lookup_enabled():
+        return None
+    try:
+        entry = shared_cache().get(overlap_cache_key(
+            device_kind(), dtype, total_bytes, n_leaves, communicator
+        ))
+        if not entry:
+            return None
+        g = int(entry["granularity"])
+        bb = int(entry.get("bucket_bytes", -1))
+    except Exception:
+        return None
+    if g < 1:
+        return None
+    return {"granularity": g, "bucket_bytes": bb if bb > 0 else None}
 
 
 def lookup_decode_block_ctx(*, n_pages: int, page_size: int, n_kv: int,
@@ -497,6 +523,96 @@ def tune_allreduce_bucket(
          "n_leaves": n_leaves, "device_size": n},
     )
     rec["kernel"] = "allreduce_bucket"
+    return rec
+
+
+def tune_overlap_schedule(
+    *,
+    communicator: str = "xla_ici",
+    total_mb: float = 64.0,
+    n_leaves: int = 64,
+    dtype="float32",
+    mesh=None,
+    cache: Optional[TuneCache] = None,
+    n1: int = 3,
+    repeats: int = 3,
+    force: bool = False,
+    dry_run: bool = False,
+    log: Optional[Callable[[str], None]] = None,
+) -> dict:
+    """Tune the backward-overlap schedule (stage granularity ×
+    ``bucket_bytes``) for one tree family.
+
+    Times the overlapped ``eager_allreduce_grad`` at each candidate —
+    the schedule's win is how well ``all-reduce-start`` pairs hide under
+    the backward compute the latency-hiding scheduler interleaves, so
+    this tuner is only meaningful on TPU (the shared
+    ``_require_tuning_allowed`` gate already refuses under pytest).
+    Persists the argmin under a key the communicators' trace-time
+    ``resolve_overlap_granularity`` lookup reads back."""
+    from chainermn_tpu.communicators.packing import synthetic_grad_tree
+
+    total_bytes = int(total_mb * 1024 * 1024)
+    tree = synthetic_grad_tree(n_leaves, total_bytes, dtypes=(dtype,))
+    total_bytes = sum(
+        l.size * l.dtype.itemsize for l in jax.tree_util.tree_leaves(tree)
+    )
+    space = overlap_schedule_search_space(total_bytes)
+    default_cfg = space[0]  # granularity 1 × the static default cap
+    key = overlap_cache_key(
+        device_kind(), dtype, total_bytes, n_leaves, communicator
+    )
+    if dry_run:
+        return {"kernel": "overlap_schedule", "dry_run": True, "key": key,
+                "candidates": space, "default": default_cfg}
+    _require_tuning_allowed("overlap schedule")
+    cache = cache or shared_cache()
+    cached = cache.get(key) if not force else None
+    if cached and int(cached.get("granularity", 0)) >= 1:
+        return {"kernel": "overlap_schedule", "key": key, "cached": True,
+                "chosen": {
+                    "granularity": int(cached["granularity"]),
+                    "bucket_bytes": int(cached["bucket_bytes"]),
+                }}
+
+    from chainermn_tpu.communicators import create_communicator
+    from chainermn_tpu.utils.profiling import sync
+
+    n = None
+    if log:
+        log(f"overlap_schedule {key}: {len(space)} candidates")
+
+    def build(cfg):
+        nonlocal n
+        comm = create_communicator(
+            communicator, mesh=mesh,
+            bucket_bytes=cfg["bucket_bytes"],
+            overlap=True, overlap_granularity=cfg["granularity"],
+        )
+        n = comm.device_size
+        stacked = jax.tree_util.tree_map(
+            lambda l: jax.numpy.stack([jax.numpy.asarray(l)] * n), tree
+        )
+
+        def run(k):
+            t0 = time.perf_counter()
+            out = stacked
+            for _ in range(k):
+                out = comm.eager_allreduce_grad(out)
+            sync(jax.tree_util.tree_leaves(out)[0])
+            return time.perf_counter() - t0
+
+        return run
+
+    results = measure_candidates(build, space, n1=n1, repeats=repeats,
+                                 log=log)
+    rec = _finish(
+        key, results, default_cfg, cache,
+        {"kernel": "overlap_schedule", "dtype": dtype_name(dtype),
+         "communicator": communicator, "total_bytes": total_bytes,
+         "n_leaves": n_leaves, "device_size": n},
+    )
+    rec["kernel"] = "overlap_schedule"
     return rec
 
 

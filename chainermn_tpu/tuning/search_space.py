@@ -267,3 +267,48 @@ def bucket_cache_key(dev_kind: str, dtype, total_bytes: int,
         (("b", bucket_pow2(total_bytes)), ("l", bucket_pow2(n_leaves))),
         {"comm": str(communicator)},
     )
+
+
+#: candidate overlap-schedule stage widths (buckets emitted per stage):
+#: 1 is maximal overlap (each bucket's allreduce-start issues the moment
+#: its last grad leaf exists), wider stages amortize dispatch overhead
+#: when buckets are small.
+OVERLAP_GRANULARITY_CANDIDATES = (1, 2, 4)
+
+
+def overlap_schedule_search_space(
+        total_bytes: Optional[int] = None) -> List[dict]:
+    """Candidate ``{"granularity", "bucket_bytes"}`` configs for the
+    backward-overlapped allreduce schedule — the cross product of stage
+    width and the (nonzero) bucket-cap ladder, since the two knobs trade
+    against each other: smaller buckets expose more overlap points but
+    need wider stages to keep per-collective dispatch cost amortized.
+    The static default (granularity 1 × the 4 MiB default cap) is always
+    first; ``bucket_bytes=0`` is excluded because the unbucketed path
+    has no schedule to stage."""
+    from chainermn_tpu.communicators.packing import DEFAULT_BUCKET_BYTES
+
+    caps = [c["bucket_bytes"] for c in bucket_search_space(total_bytes)
+            if c["bucket_bytes"] > 0]
+    out = [{"granularity": 1, "bucket_bytes": DEFAULT_BUCKET_BYTES}]
+    for g in OVERLAP_GRANULARITY_CANDIDATES:
+        for b in caps:
+            cfg = {"granularity": g, "bucket_bytes": b}
+            if cfg not in out:
+                out.append(cfg)
+    return out
+
+
+def overlap_cache_key(dev_kind: str, dtype, total_bytes: int,
+                      n_leaves: int, communicator: str) -> str:
+    """Cache key for the overlap schedule: same family signature as
+    :func:`bucket_cache_key` (the schedule is a property of the same
+    tree family) under a distinct kernel tag, so the two tuned answers
+    coexist and ``bucket_bytes`` tuned alone stays valid."""
+    return make_key(
+        "overlap_schedule",
+        dev_kind,
+        dtype,
+        (("b", bucket_pow2(total_bytes)), ("l", bucket_pow2(n_leaves))),
+        {"comm": str(communicator)},
+    )

@@ -32,12 +32,21 @@ def _flagged(report):
     return sorted({f.rule for f in report.findings})
 
 
+def _analyze_fixture(t):
+    from chainermn_tpu.analysis import analyze_fn, analyze_jaxpr
+
+    if "audit" in t:  # pre-computed census (e.g. compiled-HLO fixtures)
+        return analyze_jaxpr(
+            t["audit"], comm=t["comm"], n_leaves=t.get("n_leaves")
+        )
+    return analyze_fn(t["fn"], *t["args"], comm=t["comm"], **t["kwargs"])
+
+
 def _fixture_report(name):
-    from chainermn_tpu.analysis import analyze_fn
     from chainermn_tpu.analysis.fixtures import FIXTURES
 
     t = FIXTURES[name]()
-    return t, analyze_fn(t["fn"], *t["args"], comm=t["comm"], **t["kwargs"])
+    return t, _analyze_fixture(t)
 
 
 # ----------------------------------------------------------------------
@@ -364,15 +373,12 @@ def _regen():
         os.environ["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8"
         ).strip()
-    from chainermn_tpu.analysis import analyze_fn
     from chainermn_tpu.analysis.fixtures import FIXTURES
 
     flagged = {}
     for name in sorted(FIXTURES):
         t = FIXTURES[name]()
-        report = analyze_fn(
-            t["fn"], *t["args"], comm=t["comm"], **t["kwargs"]
-        )
+        report = _analyze_fixture(t)
         flagged[name] = _flagged(report)
         if t["expect"] is None:  # clean fixture: nothing may fire
             assert flagged[name] == [], (name, report.render())

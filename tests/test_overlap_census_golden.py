@@ -1,0 +1,172 @@
+"""Golden-file regression test for the backward-OVERLAPPED allreduce
+schedule — the companion of ``tests/test_hlo_census_golden.py`` (which
+pins the eager emission via ``overlap=False``).
+
+Pins, per communicator, the jaxpr-level census of ``allreduce_grad``
+over the canonical 64-leaf tree under the overlapped schedule: the op
+counts and reduction totals must be IDENTICAL to the eager golden (the
+schedule only reorders emission — no extra collectives per bucket), and
+the per-bucket ``op_bytes`` sequence must follow the schedule's reverse
+leaf-production bucket order, which is what lets each bucket's
+``all-reduce-start`` issue while earlier-leaf gradients are still being
+produced.  The schedule itself (bucket emission order, stage shape) is
+pinned alongside so an ordering regression fails structurally.
+
+Regenerate after an INTENDED schedule/lowering change::
+
+    python tests/test_overlap_census_golden.py --regen
+
+then review the golden diff like any other code change.
+"""
+
+import json
+import os
+
+GOLDEN_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "golden", "allreduce_census_64leaf_overlap.json",
+)
+
+#: fixed scenario — matches tests/test_hlo_census_golden.py.
+MESH_SHAPE = (2, 4)
+N_LEAVES = 64
+TOTAL_BYTES = 8 * 1024 * 1024
+BUCKET_BYTES = 256 * 1024
+
+COMMUNICATORS = ["naive", "flat", "xla_ici", "hierarchical",
+                 "two_dimensional"]
+
+
+def compute_census() -> dict:
+    """The overlapped schedule's census for the pinned scenario (imports
+    inside so ``--regen`` can set platform env before jax loads)."""
+    import jax
+
+    from chainermn_tpu.communicators import (
+        build_mesh,
+        build_overlap_schedule,
+        create_communicator,
+    )
+    from chainermn_tpu.communicators.packing import (
+        GradPacker,
+        synthetic_grad_tree,
+    )
+    from chainermn_tpu.observability import audit_allreduce_tree
+
+    devs = jax.devices()[: MESH_SHAPE[0] * MESH_SHAPE[1]]
+    mesh = build_mesh(
+        inter_size=MESH_SHAPE[0], intra_size=MESH_SHAPE[1], devices=devs
+    )
+    tree = synthetic_grad_tree(N_LEAVES, TOTAL_BYTES)
+    packer = GradPacker.for_tree(tree, bucket_bytes=BUCKET_BYTES)
+    schedule = build_overlap_schedule(packer, granularity=1)
+    out = {
+        "mesh": list(MESH_SHAPE),
+        "n_leaves": N_LEAVES,
+        "total_bytes": TOTAL_BYTES,
+        "bucket_bytes": BUCKET_BYTES,
+        "n_buckets": packer.n_buckets,
+        "schedule": {
+            "granularity": schedule.granularity,
+            "order": list(schedule.order),
+            "stages": [list(s) for s in schedule.stages],
+        },
+        "communicators": {},
+    }
+    for name in COMMUNICATORS:
+        comm = create_communicator(
+            name, mesh=mesh, bucket_bytes=BUCKET_BYTES, overlap=True,
+            overlap_granularity=1,
+        )
+        audit = audit_allreduce_tree(comm, tree)
+        out["communicators"][name] = {
+            "hlo_collectives": audit.census(),
+            "reduction_collectives": audit.reduction_collectives(),
+            "per_axis_operand_bytes": dict(
+                sorted(audit.bytes_per_axis.items())
+            ),
+            "op_bytes": {k: list(v) for k, v in
+                         sorted(audit.op_bytes.items())},
+        }
+    return out
+
+
+def test_overlap_census_matches_golden():
+    with open(GOLDEN_PATH) as f:
+        golden = json.load(f)
+    current = compute_census()
+    for name in COMMUNICATORS:
+        assert current["communicators"][name] == \
+            golden["communicators"][name], (
+                f"{name} overlapped collective census drifted from the "
+                f"golden file — if the schedule change is intended, "
+                f"regenerate with: python {__file__} --regen"
+            )
+    assert current == golden
+
+
+def test_overlap_matches_eager_counts():
+    """The ISSUE acceptance bound, as a cross-golden invariant: the
+    overlapped schedule emits exactly the eager bucketed counts — same
+    collectives per bucket, only the emission order differs."""
+    eager_path = os.path.join(
+        os.path.dirname(GOLDEN_PATH), "allreduce_census_64leaf.json"
+    )
+    with open(GOLDEN_PATH) as f:
+        golden = json.load(f)
+    with open(eager_path) as f:
+        eager = json.load(f)
+    for name in COMMUNICATORS:
+        ov = golden["communicators"][name]
+        eg = eager["communicators"][name]["bucketed"]
+        assert ov["hlo_collectives"] == eg["hlo_collectives"]
+        assert ov["reduction_collectives"] == eg["reduction_collectives"]
+        assert ov["per_axis_operand_bytes"] == eg["per_axis_operand_bytes"]
+        # same multiset of per-bucket payloads, schedule-order sequence
+        for prim, sizes in ov["op_bytes"].items():
+            assert sorted(sizes) == sorted(eg["op_bytes"][prim]), prim
+
+
+def test_schedule_is_reverse_leaf_production_order():
+    """The pinned emission order must be the reverse leaf-production
+    order: a bucket whose last leaf appears later in the flatten order
+    (produced EARLIER by reverse-mode AD) is emitted first."""
+    with open(GOLDEN_PATH) as f:
+        golden = json.load(f)
+    from chainermn_tpu.communicators.packing import (
+        GradPacker,
+        synthetic_grad_tree,
+    )
+
+    tree = synthetic_grad_tree(N_LEAVES, TOTAL_BYTES)
+    packer = GradPacker.for_tree(tree, bucket_bytes=BUCKET_BYTES)
+    order = golden["schedule"]["order"]
+    assert sorted(order) == list(range(packer.n_buckets))
+    last_leaf = [max(packer.buckets[i].leaf_indices) for i in order]
+    assert last_leaf == sorted(last_leaf, reverse=True)
+
+
+if __name__ == "__main__":
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--regen", action="store_true",
+                    help="rewrite the golden file from the current lowering")
+    args = ap.parse_args()
+    if not args.regen:
+        ap.error("run under pytest, or pass --regen to regenerate")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+    census = compute_census()
+    with open(GOLDEN_PATH, "w") as f:
+        json.dump(census, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {GOLDEN_PATH}", file=sys.stderr)

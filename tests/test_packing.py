@@ -216,6 +216,58 @@ def test_bucketed_matches_unbucketed(mesh24, name):
             )
 
 
+@pytest.mark.parametrize("name", ALL_NAMES)
+def test_overlapped_matches_eager_bit_exact(mesh24, name):
+    """The tentpole acceptance bound: the backward-overlapped schedule is
+    BIT-exact against the eager bucketed path on every communicator —
+    the same per-bucket collectives over the same operands, only the
+    emission order differs, so the results are byte-identical (not
+    merely allclose)."""
+    tree = synthetic_grad_tree(12, 256 * 1024)
+    overlapped = create_communicator(
+        name, mesh=mesh24, bucket_bytes=32 * 1024, overlap=True,
+        overlap_granularity=1,
+    )
+    eager = create_communicator(
+        name, mesh=mesh24, bucket_bytes=32 * 1024, overlap=False,
+    )
+    stacked = _stacked(tree, overlapped.device_size)
+
+    out_o = overlapped.eager_allreduce_grad(stacked)
+    out_e = eager.eager_allreduce_grad(stacked)
+
+    for k in tree:
+        a, b = np.asarray(out_o[k]), np.asarray(out_e[k])
+        assert a.dtype == b.dtype, k
+        np.testing.assert_array_equal(
+            a.reshape(-1).view(np.uint8),
+            b.reshape(-1).view(np.uint8),
+            err_msg=k,
+        )
+
+
+def test_overlap_granularity_bit_exact(mesh24):
+    """Stage width changes the emission batching, never the values."""
+    tree = synthetic_grad_tree(12, 256 * 1024)
+    base = create_communicator(
+        "xla_ici", mesh=mesh24, bucket_bytes=32 * 1024, overlap=False,
+    )
+    stacked = _stacked(tree, base.device_size)
+    ref = base.eager_allreduce_grad(stacked)
+    for g in (1, 3, 100):
+        comm = create_communicator(
+            "xla_ici", mesh=mesh24, bucket_bytes=32 * 1024, overlap=True,
+            overlap_granularity=g,
+        )
+        out = comm.eager_allreduce_grad(stacked)
+        for k in tree:
+            np.testing.assert_array_equal(
+                np.asarray(out[k]).reshape(-1).view(np.uint8),
+                np.asarray(ref[k]).reshape(-1).view(np.uint8),
+                err_msg=f"granularity={g} {k}",
+            )
+
+
 @pytest.mark.parametrize("name", ["xla_ici", "hierarchical"])
 def test_bucketed_allreduce_grad_dtype_roundtrip(mesh24, name):
     """allreduce_grad_dtype cast composes with bucketing: leaves come
@@ -274,6 +326,40 @@ def test_env_escape_hatch(mesh24, monkeypatch):
 
     with pytest.raises(ValueError, match="bucket_bytes"):
         create_communicator("naive", mesh=mesh24, bucket_bytes=-1)
+
+
+def test_overlap_env_escape_hatch(mesh24, monkeypatch):
+    from chainermn_tpu.communicators.overlap import (
+        ENV_OVERLAP,
+        ENV_OVERLAP_GRANULARITY,
+    )
+
+    comm = create_communicator("naive", mesh=mesh24)
+    monkeypatch.delenv(ENV_OVERLAP, raising=False)
+    assert comm.resolve_overlap() is True  # ON by default
+
+    for off in ("0", "false", "off", "no"):
+        monkeypatch.setenv(ENV_OVERLAP, off)
+        assert comm.resolve_overlap() is False
+    monkeypatch.setenv(ENV_OVERLAP, "1")
+    assert comm.resolve_overlap() is True
+
+    # Call-site pin beats ctor beats env.
+    monkeypatch.setenv(ENV_OVERLAP, "0")
+    pinned = create_communicator("naive", mesh=mesh24, overlap=True)
+    assert pinned.resolve_overlap() is True
+    assert pinned.resolve_overlap(overlap=False) is False
+    assert comm.resolve_overlap(overlap=True) is True
+
+    # Granularity: ctor → env → default 1.
+    monkeypatch.delenv(ENV_OVERLAP_GRANULARITY, raising=False)
+    assert comm.resolve_overlap_granularity() == 1
+    monkeypatch.setenv(ENV_OVERLAP_GRANULARITY, "3")
+    assert comm.resolve_overlap_granularity() == 3
+    g2 = create_communicator("naive", mesh=mesh24, overlap_granularity=2)
+    assert g2.resolve_overlap_granularity() == 2
+    with pytest.raises(ValueError, match="overlap_granularity"):
+        create_communicator("naive", mesh=mesh24, overlap_granularity=0)
 
 
 #: reduction collectives each variant lowers PER BUCKET: one fused psum
