@@ -107,6 +107,22 @@ def _allreduce_overlap(lowerable, *args):
         return None
 
 
+def _flagship_gauges(flagship: str, mfu, overlap_rec) -> None:
+    """Publish the headline efficiency numbers as Reporter gauges so the
+    tools.obs Prometheus path exports them next to the serving metrics
+    (``bench/mfu/<flagship>``, ``bench/overlap_fraction/<flagship>``)."""
+    from chainermn_tpu.observability import get_reporter
+
+    rep = get_reporter()
+    if rep is None:
+        return
+    if mfu is not None:
+        rep.gauge(f"bench/mfu/{flagship}", float(mfu))
+    if overlap_rec and overlap_rec.get("overlap_fraction") is not None:
+        rep.gauge(f"bench/overlap_fraction/{flagship}",
+                  float(overlap_rec["overlap_fraction"]))
+
+
 def bench_resnet(comm, args):
     from chainermn_tpu.models.resnet import ResNet50
 
@@ -256,6 +272,7 @@ def bench_resnet(comm, args):
     overlap_rec = _allreduce_overlap(
         step, params, state, batch_stats, (x, y)
     )
+    _flagship_gauges("resnet", mfu, overlap_rec)
     return {
         "metric": metric,
         "overlap": comm.resolve_overlap(),
@@ -393,14 +410,16 @@ def bench_lm(comm, args):
     tok_per_chip = B * S / step_time
     mfu = model_flops / step_time / V5E_BF16_PEAK
     hw_util = step_flops_per_dev / step_time / V5E_BF16_PEAK
+    overlap_rec = _allreduce_overlap(
+        step, params, state, (tokens, labels)
+    )
+    _flagship_gauges("lm", mfu, overlap_rec)
     result = {
         "metric": "tokens/sec/chip decoder-LM train step "
                   "(flash attention + fused CE"
                   + (" + remat" if use_remat else "") + ", AdamW)",
         "overlap": comm.resolve_overlap(),
-        "allreduce_overlap": _allreduce_overlap(
-            step, params, state, (tokens, labels)
-        ),
+        "allreduce_overlap": overlap_rec,
         "value": round(tok_per_chip, 1),
         "unit": "tokens/sec/chip",
         "mfu_vs_v5e_peak": round(mfu, 4),
@@ -543,6 +562,8 @@ def bench_serve(comm, args):
                   "(paged KV + jitted decode)",
         "value": best["tokens_per_sec"],
         "unit": "tokens/sec",
+        "trace": _bench_serve_traced(args, model, params, best,
+                                     prompts),
         "best_batch_size": best["batch_size"],
         "config": {**cfg, "prompt_len": P, "new_tokens": N,
                    "n_requests": args.serve_requests,
@@ -554,6 +575,79 @@ def bench_serve(comm, args):
     if args.serve_replicas > 1:
         out["cluster"] = bench_serve_cluster(args, model, params)
     return out
+
+
+def _bench_serve_traced(args, model, params, best, prompts):
+    """Rerun the winning sweep point with the request tracer installed:
+    per-stage p50/p99 measured from real spans, plus the zero-overhead
+    guard — the traced run must compile exactly as many prefill/decode
+    buckets as the untraced one (tracing never touches jit inputs), and
+    the throughput delta is reported so regressions are visible."""
+    from chainermn_tpu.observability import tracing
+    from chainermn_tpu.serving import (
+        ContinuousBatchingScheduler,
+        EngineConfig,
+        InferenceEngine,
+        QueueFull,
+        SamplingParams,
+        ServeFrontend,
+    )
+
+    N = args.serve_new_tokens
+    bs = best["batch_size"]
+    engine = InferenceEngine(model, params, EngineConfig(
+        block_size=args.serve_block_size, n_blocks=args.serve_blocks,
+        max_len=args.serve_max_len, max_batch=bs,
+    ))
+    sched = ContinuousBatchingScheduler(engine)
+    fe = ServeFrontend(sched, max_queue=args.serve_queue)
+    fe.submit(prompts[0], N, sampling=SamplingParams())
+    fe.run_until_idle()
+
+    tr = tracing.Tracer()
+    tracing.install(tr)
+    try:
+        handles = []
+        t0 = time.perf_counter()
+        for p in prompts:
+            while True:
+                try:
+                    handles.append(
+                        fe.submit(p, N, sampling=SamplingParams())
+                    )
+                    break
+                except QueueFull:
+                    fe.step()
+        fe.run_until_idle()
+        wall = time.perf_counter() - t0
+    finally:
+        tracing.uninstall(tr)
+    recs = tr.records()
+    tr.close()
+
+    st = engine.stats()
+    total = sum(len(h.tokens) for h in handles)
+    traced_tps = total / wall if wall > 0 else 0.0
+    off_tps = best["tokens_per_sec"]
+    return {
+        "batch_size": bs,
+        "traced_tokens_per_sec": round(traced_tps, 1),
+        "untraced_tokens_per_sec": off_tps,
+        "overhead_pct": round(100.0 * (1.0 - traced_tps / off_tps), 2)
+        if off_tps else None,
+        "extra_compiles": (
+            (st["prefill_compiles"] - best["prefill_compiles"])
+            + (st["decode_compiles"] - best["decode_compiles"])
+        ),
+        "stages": {
+            name: {"count": s["count"],
+                   "p50_ms": round(s["p50_s"] * 1e3, 3),
+                   "p99_ms": round(s["p99_s"] * 1e3, 3)}
+            for name, s in sorted(
+                tracing.stage_percentiles(recs).items()
+            )
+        },
+    }
 
 
 def bench_serve_cluster(args, model, params):
@@ -597,7 +691,14 @@ def bench_serve_cluster(args, model, params):
                           args.serve_batch_sizes.split(",")),
         ))
 
-    def run_point(roles, prompts, prefill_threshold=None):
+    def run_point(roles, prompts, prefill_threshold=None,
+                  traced=False):
+        from chainermn_tpu.observability import tracing
+
+        tr = None
+        if traced:
+            tr = tracing.Tracer()
+            tracing.install(tr)
         reps = [
             Replica(i, make_engine(), role=roles[i],
                     max_queue=args.serve_queue)
@@ -640,7 +741,7 @@ def bench_serve_cluster(args, model, params):
         gaps.sort()
         p99 = (gaps[min(len(gaps) - 1, int(0.99 * len(gaps)))]
                if gaps else None)
-        return {
+        point = {
             "tokens_per_sec": round(total / wall, 1),
             "finished": sum(1 for h in handles
                             if h.status == "finished"),
@@ -648,6 +749,18 @@ def bench_serve_cluster(args, model, params):
             "short_p99_token_latency_ms":
                 round(p99 * 1e3, 3) if p99 is not None else None,
         }
+        if tr is not None:
+            tracing.uninstall(tr)
+            point["trace_stages"] = {
+                name: {"count": s["count"],
+                       "p50_ms": round(s["p50_s"] * 1e3, 3),
+                       "p99_ms": round(s["p99_s"] * 1e3, 3)}
+                for name, s in sorted(
+                    tracing.stage_percentiles(tr.records()).items()
+                )
+            }
+            tr.close()
+        return point
 
     # Routed throughput: all replicas decode-capable, short traffic.
     routed = run_point(["both"] * R, short_prompts)
@@ -658,8 +771,10 @@ def bench_serve_cluster(args, model, params):
     # Disagg: one prefill-role replica absorbs the long prompt; the
     # decode fleet never runs its prefill.
     roles = ["prefill"] + ["decode"] * (R - 1)
+    # Traced: the disagg point's span tree is where queue/prefill/
+    # handoff/decode stage latencies all appear at once.
     disagg = run_point(roles, mixed,
-                       prefill_threshold=long_len)
+                       prefill_threshold=long_len, traced=True)
     proof = None
     if (baseline["short_p99_token_latency_ms"] is not None
             and disagg["short_p99_token_latency_ms"] is not None):
@@ -782,10 +897,17 @@ def main(argv=None):
 
     telemetry = contextlib.ExitStack()
     recorder = None
+    reporter = None
     if args.step_log:
-        from chainermn_tpu.observability import StepRecorder
+        from chainermn_tpu.observability import Reporter, StepRecorder
+        from chainermn_tpu.observability import reporter as reporter_mod
 
         recorder = telemetry.enter_context(StepRecorder(args.step_log))
+        # Reporter scope so the flagship MFU / overlap-fraction gauges
+        # (and any serving-stage histograms) have somewhere to land;
+        # the summary is flushed into the step log at exit.
+        reporter = Reporter()
+        telemetry.enter_context(reporter_mod.scope(reporter))
 
     if args.serve:
         out = bench_serve(comm, args)
@@ -800,6 +922,8 @@ def main(argv=None):
         out["allreduce_tree"] = _allreduce_tree_table()
     if recorder is not None:
         recorder.step()  # flush buffered compile events and step spans
+        if reporter is not None:
+            recorder.record("reporter", summary=reporter.summary())
         recorder.record("bench_result", result=out)
     telemetry.close()
     print(json.dumps(out))

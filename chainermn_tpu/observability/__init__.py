@@ -16,6 +16,10 @@ telemetry is library-native and SPMD-aware:
 * :func:`span` — named regions on the profiler timeline AND in the
   JSONL log with host-side durations; :func:`named_scope` for traced
   code.
+* :mod:`tracing` — cross-replica request tracing for the serving tier:
+  :class:`SpanCtx` contexts over the cluster wire, a crash-surviving
+  :class:`FlightRecorder`, Chrome-trace export, per-stage percentiles,
+  SLO burn-rate gauges and a straggler detector.
 
 Summarize/export a log with ``python -m chainermn_tpu.tools.obs``
 (incl. Prometheus textfile output).  See ``docs/observability.md``.
@@ -52,4 +56,20 @@ from chainermn_tpu.observability.spans import (  # noqa: F401
     named_scope,
     span,
     telemetry_active,
+)
+from chainermn_tpu.observability.tracing import (  # noqa: F401
+    FlightRecorder,
+    SLOConfig,
+    SpanCtx,
+    Tracer,
+    detect_stragglers,
+    get_tracer,
+    read_flight,
+    read_flight_dir,
+    stage_percentiles,
+    stitch,
+    to_chrome_trace,
+    trace_scope,
+    tracing_active,
+    validate_trace,
 )

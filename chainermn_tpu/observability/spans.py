@@ -42,20 +42,36 @@ def telemetry_active() -> bool:
 
 @contextlib.contextmanager
 def span(name: str):
-    """Named host-side region: profiler annotation + duration fan-out."""
+    """Named host-side region: profiler annotation + duration fan-out.
+
+    Exception-safe: the duration is recorded (and the span marked as an
+    error) even when the body raises, so a failed request can't leave a
+    half-open span behind for the next request on the thread.  The
+    exception propagates unchanged.
+    """
     from chainermn_tpu.utils.profiling import annotate
 
     t0 = time.perf_counter()
-    with annotate(name):
-        yield
-    dt = time.perf_counter() - t0
-    rep = _reporter.get_reporter()
-    if rep is not None:
-        rep.observe(f"span/{name}", dt)
-        rep.histogram_observe(f"span/{name}", dt)
-    rec = _step_log.current_recorder()
-    if rec is not None:
-        rec.add_span(name, dt)
+    err = False
+    try:
+        with annotate(name):
+            yield
+    except BaseException:
+        err = True
+        raise
+    finally:
+        dt = time.perf_counter() - t0
+        rep = _reporter.get_reporter()
+        if rep is not None:
+            rep.observe(f"span/{name}", dt)
+            rep.histogram_observe(f"span/{name}", dt)
+            if err:
+                rep.count(f"span/{name}/errors", 1)
+        rec = _step_log.current_recorder()
+        if rec is not None:
+            rec.add_span(name, dt)
+            if err:
+                rec.add_span(f"{name}/error", dt)
 
 
 def named_scope(name: str):

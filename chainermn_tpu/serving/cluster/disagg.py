@@ -29,6 +29,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+from chainermn_tpu.observability import tracing as _tracing
 from chainermn_tpu.serving.cluster.migration import (
     KVSnapshot,
     extract_sequence,
@@ -46,6 +47,8 @@ class PrefillJob:
     prompt: list
     sampling: object
     attempts: int = 0
+    #: root trace context the prefill span parents to (None untraced).
+    trace: Optional[_tracing.SpanCtx] = None
 
 
 @dataclasses.dataclass
@@ -65,10 +68,12 @@ class PrefillResult:
 _scratch_counter = 0
 
 
-def run_prefill_job(engine, job: PrefillJob) -> Optional[PrefillResult]:
+def run_prefill_job(engine, job: PrefillJob,
+                    replica=None) -> Optional[PrefillResult]:
     """Execute one prefill job on ``engine`` (a prefill-role replica's).
     Returns the result, or None when the pool momentarily can't hold the
-    prompt (caller requeues; ``attempts`` counts the retries)."""
+    prompt (caller requeues; ``attempts`` counts the retries).
+    ``replica`` stamps the prefill span when tracing is active."""
     global _scratch_counter
     L = len(job.prompt)
     need = engine.kv.blocks_for(L)
@@ -83,6 +88,9 @@ def run_prefill_job(engine, job: PrefillJob) -> Optional[PrefillResult]:
     if not engine.kv.can_allocate(L):
         job.attempts += 1
         return None  # transient: other prefills hold the pool
+    tr = _tracing.get_tracer()
+    traced = tr is not None and job.trace is not None
+    t0 = tr.clock() if traced else 0.0
     _scratch_counter += 1
     sid = ("prefill_scratch", _scratch_counter)
     engine.kv.allocate(sid, L)
@@ -91,9 +99,17 @@ def run_prefill_job(engine, job: PrefillJob) -> Optional[PrefillResult]:
         first = engine.sample(logits, job.sampling, L)
         snap = extract_sequence(engine, sid, context=list(job.prompt))
     except ValueError as e:
+        if traced:
+            tr.record_span("prefill", job.trace, t0, tr.clock() - t0,
+                           replica=replica, error=True, tokens=L,
+                           disagg=True)
         return PrefillResult(job=job, error=str(e))
     finally:
         engine.kv.free(sid)
+    if traced:
+        tr.record_span("prefill", job.trace, t0, tr.clock() - t0,
+                       replica=replica, tokens=L, disagg=True,
+                       attempts=job.attempts)
     return PrefillResult(job=job, snapshot=snap, first_token=first)
 
 
@@ -109,6 +125,9 @@ def place_handoff(replica, result: PrefillResult, req,
     eng = replica.scheduler.engine
     if len(replica.scheduler.running) >= eng.max_batch:
         return None
+    tr = _tracing.get_tracer()
+    traced = tr is not None and req.trace is not None
+    t0 = tr.clock() if traced else 0.0
     rid = replica.frontend.reserve_id()
     try:
         restore_sequence(eng, result.snapshot, rid)
@@ -116,7 +135,12 @@ def place_handoff(replica, result: PrefillResult, req,
         return None
     req.request_id = rid
     try:
-        return replica.frontend.adopt(req, timeout_s=timeout_s)
+        handle = replica.frontend.adopt(req, timeout_s=timeout_s)
     except OutOfBlocks:
         eng.kv.free(rid)
         return None
+    if traced:
+        tr.record_span("handoff", req.trace, t0, tr.clock() - t0,
+                       replica=replica.replica_id,
+                       tokens=len(req.context))
+    return handle

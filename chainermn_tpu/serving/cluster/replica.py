@@ -102,7 +102,8 @@ class Replica:
             reporter=reporter, replica=replica_id,
         )
         self.frontend = ServeFrontend(
-            self.scheduler, max_queue=max_queue, clock=clock
+            self.scheduler, max_queue=max_queue, clock=clock,
+            replica=replica_id,
         )
         self.alive = True
         self.draining = False
@@ -170,7 +171,8 @@ class Replica:
         they don't count here)."""
         if self._prefill_jobs and self.can_prefill:
             job = self._prefill_jobs.popleft()
-            result = run_prefill_job(self.engine, job)
+            result = run_prefill_job(self.engine, job,
+                                     replica=self.replica_id)
             if result is None:
                 # Transient page pressure: retry behind other jobs so
                 # one stuck prompt doesn't head-of-line block the rest.

@@ -38,6 +38,7 @@ import dataclasses
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from chainermn_tpu.observability import tracing as _tracing
 from chainermn_tpu.serving.cluster.disagg import (
     PrefillJob,
     PrefillResult,
@@ -71,6 +72,11 @@ class ClusterHandle:
     failovers: int = 0
     #: (replica_id, replica-local request id) of the live placement.
     _local: Optional[Tuple[object, int]] = None
+    #: trace id when tracing is active (None otherwise).
+    trace_id: Optional[str] = None
+    #: root span context — the router owns the request's root because
+    #: it survives replica failover (see observability/tracing.py).
+    _trace_root: Optional[_tracing.SpanCtx] = None
 
     @property
     def done(self) -> bool:
@@ -99,7 +105,8 @@ class ReplicaRouter:
                  prefill_threshold: Optional[int] = None,
                  reporter=None,
                  health: Optional[HeartbeatMonitor] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 straggler_k: float = 4.0):
         if not replicas:
             raise ValueError("a router needs at least one replica")
         ids = [r.replica_id for r in replicas]
@@ -118,6 +125,10 @@ class ReplicaRouter:
         self._pending_handoffs: List[Tuple[PrefillResult,
                                            ClusterHandle]] = []
         self._next_gid = 0
+        #: flag a replica whose stage-latency median exceeds this
+        #: multiple of the fleet median (see tracing.detect_stragglers).
+        self.straggler_k = float(straggler_k)
+        self._steps = 0
 
     # -- scoring -------------------------------------------------------
     @staticmethod
@@ -203,30 +214,68 @@ class ReplicaRouter:
             on_token=on_token,
         )
         self._handles[gid] = handle
-        if (
-            self.prefill_threshold is not None
-            and len(handle.prompt) >= self.prefill_threshold
-            and self._pick_prefill_replica() is not None
-        ):
-            self._submit_disagg(handle)
-        else:
-            self._place(handle, committed=[])
+        tr = _tracing.get_tracer()
+        if tr is not None:
+            handle._trace_root = tr.begin(
+                "request", replica="router", rid=gid,
+                prompt_len=len(handle.prompt),
+                max_new_tokens=handle.max_new_tokens,
+            )
+            handle.trace_id = handle._trace_root.trace_id
+        try:
+            if (
+                self.prefill_threshold is not None
+                and len(handle.prompt) >= self.prefill_threshold
+                and self._pick_prefill_replica() is not None
+            ):
+                self._submit_disagg(handle)
+            else:
+                self._place(handle, committed=[])
+        except QueueFull:
+            self._close_trace(handle, status="rejected",
+                              error="no replica admits this request")
+            raise
         return handle
 
+    def _close_trace(self, handle: ClusterHandle,
+                     status: Optional[str] = None,
+                     error: Optional[str] = None) -> None:
+        """End the handle's root span (idempotent, no-op untraced)."""
+        root = handle._trace_root
+        if root is None:
+            return
+        handle._trace_root = None
+        tr = _tracing.get_tracer()
+        if tr is not None:
+            tr.end(root, error=error or handle.error,
+                   status=status or handle.status,
+                   tokens=len(handle.tokens),
+                   failovers=handle.failovers)
+
     def _submit_disagg(self, handle: ClusterHandle) -> None:
+        tr = _tracing.get_tracer()
+        root = handle._trace_root
+        t0 = tr.clock() if (tr is not None and root is not None) else 0.0
         rep = self._pick_prefill_replica()
         job = PrefillJob(
             handle=handle, prompt=list(handle.prompt),
-            sampling=handle.sampling,
+            sampling=handle.sampling, trace=root,
         )
         with rep.lock:
             rep.enqueue_prefill(job)
+        if tr is not None and root is not None:
+            tr.record_span("placement", root, t0, tr.clock() - t0,
+                           replica="router", target=str(rep.replica_id),
+                           kind="prefill")
         handle.status = "prefill"
         handle.replica_id = rep.replica_id
 
     def _place(self, handle: ClusterHandle, committed: List[int]) -> None:
         """Submit (or re-submit, with a committed prefix) onto the best
         decode replica."""
+        tr = _tracing.get_tracer()
+        root = handle._trace_root
+        t0 = tr.clock() if (tr is not None and root is not None) else 0.0
         now = self.clock()
         rep = self.pick_decode_replica(
             len(handle.prompt) + len(committed),
@@ -251,7 +300,12 @@ class ReplicaRouter:
                 timeout_s=handle._remaining_timeout(now),
                 on_token=lambda _rid, tok: handle._commit(tok),
                 committed=committed,
+                trace=root,
             )
+        if tr is not None and root is not None:
+            tr.record_span("placement", root, t0, tr.clock() - t0,
+                           replica="router", target=str(rep.replica_id),
+                           committed=len(committed))
         handle.status = "routed"
         handle.replica_id = rep.replica_id
         handle._local = (rep.replica_id, local.request_id)
@@ -286,11 +340,15 @@ class ReplicaRouter:
 
     def _try_place_handoff(self, res: PrefillResult,
                            handle: ClusterHandle) -> bool:
+        tr = _tracing.get_tracer()
+        root = handle._trace_root
         if not handle.tokens:
             # First token was sampled by the prefill replica; commit it
             # exactly once, at handoff (stream order is preserved: the
             # request isn't decoding anywhere yet).
             handle._commit(res.first_token)
+            if tr is not None and root is not None:
+                tr.token(root)
             if (
                 len(handle.tokens) >= handle.max_new_tokens
                 or res.first_token == handle.stop_token
@@ -311,6 +369,7 @@ class ReplicaRouter:
             sampling=handle.sampling,
             stop_token=handle.stop_token,
             on_token=lambda _rid, tok: handle._commit(tok),
+            trace=root,
         )
         req.generated = list(handle.tokens)
         with rep.lock:
@@ -373,6 +432,11 @@ class ReplicaRouter:
         return moved
 
     def _requeue(self, handle: ClusterHandle, reason: str) -> None:
+        tr = _tracing.get_tracer()
+        if tr is not None and handle._trace_root is not None:
+            tr.event("failover", handle._trace_root, replica="router",
+                     reason=reason, from_replica=str(handle.replica_id),
+                     committed=len(handle.tokens))
         try:
             self._place(handle, committed=list(handle.tokens))
         except QueueFull as e:
@@ -389,6 +453,7 @@ class ReplicaRouter:
         """One router iteration.  Returns tokens emitted fleet-wide
         (only meaningful when ``drive_replicas``)."""
         now = self.clock()
+        self._steps += 1
         if self.health is not None:
             for rid in self.health.check(now):
                 self.fail_replica(rid, reason="missed heartbeats")
@@ -413,7 +478,28 @@ class ReplicaRouter:
                 "serving/cluster/pending_handoffs",
                 len(self._pending_handoffs),
             )
+            self._straggler_gauges()
         return emitted
+
+    def _straggler_gauges(self) -> None:
+        """Periodically compare per-replica stage medians against the
+        fleet and publish flag + lag-ratio gauges (tools.obs splits the
+        ``/replica/<id>`` suffix into a Prometheus label)."""
+        tr = _tracing.get_tracer()
+        if tr is None or self._steps % 32 != 0:
+            return
+        flagged = _tracing.detect_stragglers(
+            tr.stage_stats(), k=self.straggler_k
+        )
+        for rid in self.replicas:
+            f = flagged.get(rid) or flagged.get(str(rid))
+            self.reporter.gauge(
+                f"trace/straggler/replica/{rid}", 1.0 if f else 0.0
+            )
+            if f:
+                self.reporter.gauge(
+                    f"trace/stage_lag/replica/{rid}", max(f.values())
+                )
 
     def _sync(self, now: float) -> None:
         """Propagate replica-local completion/failure/timeouts to
@@ -421,6 +507,7 @@ class ReplicaRouter:
         currently placed anywhere (pending handoffs, prefill queue)."""
         for handle in self._handles.values():
             if handle.done:
+                self._close_trace(handle)
                 continue
             if handle._local is not None:
                 rid, lid = handle._local
@@ -440,6 +527,8 @@ class ReplicaRouter:
             ):
                 handle.status = "timeout"
                 handle.error = "deadline exceeded"
+            if handle.done:
+                self._close_trace(handle)
 
     @property
     def has_work(self) -> bool:

@@ -12,8 +12,11 @@ router → replica (tag CMD)
 ---------------------------------------------------------------------
 ``{"op": "submit"}``    place a request: gid, prompt, max_new_tokens,
                         sampling, stop_token, committed (failover
-                        replay prefix), timeout_s
-``{"op": "prefill"}``   disaggregated prompt: gid, prompt, sampling
+                        replay prefix), timeout_s, trace (root span
+                        context or None — replica stage spans parent
+                        to it; see observability/tracing.py)
+``{"op": "prefill"}``   disaggregated prompt: gid, prompt, sampling,
+                        trace
 ``{"op": "send_snapshot"}``  ship gid's finished prefill snapshot to
                         global rank ``dest`` (tag SNAP)
 ``{"op": "recv_snapshot"}``  receive gid's snapshot from global rank
@@ -47,6 +50,7 @@ import time
 from typing import Dict, List, Optional
 
 from chainermn_tpu.communicators.kvtransport import ObjectPlane, PeerGone
+from chainermn_tpu.observability import tracing as _tracing
 from chainermn_tpu.serving.cluster.health import HeartbeatMonitor
 from chainermn_tpu.serving.cluster.replica import Replica, ReplicaLoad
 from chainermn_tpu.serving.cluster.router import ReplicaRouter
@@ -76,13 +80,39 @@ def run_replica(rank: int, size: int, engine_factory,
                 watermark_blocks: Optional[int] = None,
                 heartbeat_s: float = 0.2,
                 kill_after_tokens: Optional[int] = None,
-                plane: Optional[ObjectPlane] = None) -> dict:
+                plane: Optional[ObjectPlane] = None,
+                flight_path: Optional[str] = None) -> dict:
     """Serve as replica ``rank`` until the router says stop (or the
     router's edge dies).  ``engine_factory()`` builds the
     InferenceEngine (model + params + config) — construction is the
     caller's business, the loop is ours.  ``kill_after_tokens`` is the
     soak-test hook: SIGKILL THIS process after streaming that many
-    tokens (mid-stream, no cleanup — simulating a crashed host)."""
+    tokens (mid-stream, no cleanup — simulating a crashed host).
+
+    ``flight_path`` — install a tracer backed by a crash-surviving
+    :class:`FlightRecorder` at that path for the duration (no-op when a
+    tracer is already installed; the already-installed one wins)."""
+    tr = None
+    if flight_path is not None and _tracing.get_tracer() is None:
+        tr = _tracing.Tracer(
+            flight=_tracing.FlightRecorder(flight_path, replica=rank),
+            replica=rank,
+        )
+        _tracing.install(tr)
+    try:
+        return _run_replica_inner(
+            rank, size, engine_factory, role, max_queue,
+            watermark_blocks, heartbeat_s, kill_after_tokens, plane,
+        )
+    finally:
+        if tr is not None:
+            _tracing.uninstall(tr)
+            tr.close()
+
+
+def _run_replica_inner(rank, size, engine_factory, role, max_queue,
+                       watermark_blocks, heartbeat_s,
+                       kill_after_tokens, plane) -> dict:
     import os
     import signal
 
@@ -116,6 +146,9 @@ def run_replica(rank: int, size: int, engine_factory,
         gid = msg.get("gid")
         if msg["op"] == "stop":
             return False
+        tr = _tracing.get_tracer()
+        ctx = _tracing.SpanCtx.from_wire(msg.get("trace"))
+        traced = tr is not None and ctx is not None
         if msg["op"] == "submit":
             sp = SamplingParams(**msg["sampling"])
             try:
@@ -125,6 +158,7 @@ def run_replica(rank: int, size: int, engine_factory,
                     timeout_s=msg["timeout_s"],
                     on_token=on_token_for(gid),
                     committed=msg["committed"],
+                    trace=ctx,
                 )
             except QueueFull as e:
                 outbox.append(("reject", gid, e.retry_after_s))
@@ -136,6 +170,7 @@ def run_replica(rank: int, size: int, engine_factory,
             rep.enqueue_prefill(PrefillJob(
                 handle=gid, prompt=msg["prompt"],
                 sampling=SamplingParams(**msg["sampling"]),
+                trace=ctx,
             ))
         elif msg["op"] == "send_snapshot":
             from chainermn_tpu.serving.cluster.migration import (
@@ -144,13 +179,23 @@ def run_replica(rank: int, size: int, engine_factory,
 
             res = snapshots.pop(gid)
             dest = msg["dest"]
+            t0 = tr.clock() if traced else 0.0
             try:
                 send_snapshot(
                     plane, plane.members.index(dest), res.snapshot,
                     tag=SNAP,
                 )
             except PeerGone:
-                pass  # the router will see dest's death and requeue
+                if traced:
+                    tr.record_span("migrate_send", ctx, t0,
+                                   tr.clock() - t0, error=True,
+                                   dest=dest)
+                # the router will see dest's death and requeue
+            else:
+                if traced:
+                    tr.record_span("migrate_send", ctx, t0,
+                                   tr.clock() - t0, dest=dest,
+                                   tokens=len(res.snapshot.context))
         elif msg["op"] == "recv_snapshot":
             from chainermn_tpu.serving.cluster.migration import (
                 recv_snapshot,
@@ -158,6 +203,7 @@ def run_replica(rank: int, size: int, engine_factory,
             )
             from chainermn_tpu.serving.scheduler import Request
 
+            t0 = tr.clock() if traced else 0.0
             try:
                 snap = recv_snapshot(
                     plane, plane.members.index(msg["source"]),
@@ -172,12 +218,22 @@ def run_replica(rank: int, size: int, engine_factory,
                     sampling=SamplingParams(**msg["sampling"]),
                     stop_token=msg["stop_token"],
                     on_token=on_token_for(gid),
+                    trace=ctx,
                 )
                 req.generated = list(msg["committed"])
                 rep.frontend.adopt(req, timeout_s=msg["timeout_s"])
             except (PeerGone, TimeoutError, ValueError) as e:
+                if traced:
+                    tr.record_span("migrate_recv", ctx, t0,
+                                   tr.clock() - t0, error=True,
+                                   source=msg["source"])
                 outbox.append(("handoff_failed", gid, str(e)))
             else:
+                if traced:
+                    tr.record_span("migrate_recv", ctx, t0,
+                                   tr.clock() - t0,
+                                   source=msg["source"],
+                                   tokens=len(req.context))
                 gid_of_local[rid] = gid
                 outbox.append(("adopted", gid))
         return True
@@ -258,6 +314,8 @@ class _RemoteRequest:
         self.error: Optional[str] = None
         self.replica: Optional[int] = None  # subgroup rank
         self.failovers = 0
+        #: root span context (router-owned) when tracing is active.
+        self.trace = None
 
     @property
     def done(self) -> bool:
@@ -270,13 +328,41 @@ def run_router(size: int, requests: List[dict],
                miss_after_s: float = 3.0,
                timeout_s: float = 300.0,
                reporter=None,
-               plane: Optional[ObjectPlane] = None) -> Dict[int, dict]:
+               plane: Optional[ObjectPlane] = None,
+               flight_path: Optional[str] = None) -> Dict[int, dict]:
     """Drive ``requests`` (dicts: prompt, max_new_tokens, optional
     sampling/stop_token/timeout_s) to completion over replicas at
     subgroup ranks ``1..size-1``.  Returns ``{gid: {"tokens": [...],
     "status": ..., "error": ..., "failovers": n}}`` with token streams
-    exactly as a single sequential engine would produce them."""
+    exactly as a single sequential engine would produce them.
+
+    ``flight_path`` — install a FlightRecorder-backed tracer for the
+    duration; the router owns every request's ROOT span (it survives
+    replica failover), replicas contribute stage spans via the
+    ``trace`` field on CMD frames."""
+    tr = None
+    if flight_path is not None and _tracing.get_tracer() is None:
+        tr = _tracing.Tracer(
+            flight=_tracing.FlightRecorder(flight_path, replica="router"),
+            replica="router",
+        )
+        _tracing.install(tr)
+    try:
+        return _run_router_inner(
+            size, requests, prefill_threshold, roles, miss_after_s,
+            timeout_s, reporter, plane,
+        )
+    finally:
+        if tr is not None:
+            _tracing.uninstall(tr)
+            tr.close()
+
+
+def _run_router_inner(size, requests, prefill_threshold, roles,
+                      miss_after_s, timeout_s, reporter,
+                      plane) -> Dict[int, dict]:
     plane = plane or _mk_plane(0, size)
+    tr = _tracing.get_tracer()
     replica_ranks = list(range(1, size))
     alive = set(replica_ranks)
     # Role map is declared up-front (the launcher knows what it started)
@@ -296,8 +382,22 @@ def run_router(size: int, requests: List[dict],
         spec.setdefault("stop_token", None)
         spec.setdefault("timeout_s", None)
         rr = _RemoteRequest(gid, spec)
+        if tr is not None:
+            rr.trace = tr.begin(
+                "request", rid=gid, prompt_len=len(spec["prompt"]),
+                max_new_tokens=spec["max_new_tokens"],
+            )
         reqs[gid] = rr
         pending.append(rr)
+
+    def wire_trace(rr: _RemoteRequest):
+        return rr.trace.to_wire() if rr.trace is not None else None
+
+    def close_trace(rr: _RemoteRequest) -> None:
+        if tr is not None and rr.trace is not None:
+            root, rr.trace = rr.trace, None
+            tr.end(root, error=rr.error, status=rr.status,
+                   tokens=len(rr.tokens), failovers=rr.failovers)
 
     def send_cmd(rank: int, msg: dict) -> bool:
         try:
@@ -324,6 +424,7 @@ def run_router(size: int, requests: List[dict],
         return best
 
     def place(rr: _RemoteRequest) -> bool:
+        t0 = tr.clock() if (tr is not None and rr.trace) else 0.0
         r = pick_replica(rr)
         if r is None:
             return False
@@ -335,8 +436,13 @@ def run_router(size: int, requests: List[dict],
             "stop_token": rr.spec["stop_token"],
             "timeout_s": rr.spec["timeout_s"],
             "committed": list(rr.tokens),
+            "trace": wire_trace(rr),
         })
         if ok:
+            if tr is not None and rr.trace is not None:
+                tr.record_span("placement", rr.trace, t0,
+                               tr.clock() - t0, target=r,
+                               committed=len(rr.tokens))
             rr.replica = r
             rr.status = "routed"
             assigned[r].add(rr.gid)
@@ -354,6 +460,9 @@ def run_router(size: int, requests: List[dict],
             rr.failovers += 1
             rr.status = "pending"
             rr.replica = None
+            if tr is not None and rr.trace is not None:
+                tr.event("failover", rr.trace, reason=why,
+                         from_replica=rank, committed=len(rr.tokens))
             pending.append(rr)
         for gid, pr in list(prefilling.items()):
             if pr == rank:
@@ -378,13 +487,18 @@ def run_router(size: int, requests: List[dict],
             kind = ev[0]
             if kind == "tok":
                 _, gid, tok = ev
-                reqs[gid].tokens.append(int(tok))
+                rr = reqs[gid]
+                rr.tokens.append(int(tok))
+                if tr is not None and rr.trace is not None:
+                    tr.token(rr.trace)
             elif kind == "done":
                 _, gid, status, error = ev
                 rr = reqs[gid]
                 rr.status = status
                 rr.error = error
                 assigned.get(rank, set()).discard(gid)
+                if rr.done:
+                    close_trace(rr)
             elif kind == "reject":
                 _, gid, _retry = ev
                 rr = reqs[gid]
@@ -396,12 +510,15 @@ def run_router(size: int, requests: List[dict],
                 _, gid, tok = ev
                 rr = reqs[gid]
                 rr.tokens.append(int(tok))  # committed exactly once
+                if tr is not None and rr.trace is not None:
+                    tr.token(rr.trace)
                 del prefilling[gid]
                 if (
                     len(rr.tokens) >= rr.spec["max_new_tokens"]
                     or tok == rr.spec["stop_token"]
                 ):
                     rr.status = "finished"
+                    close_trace(rr)
                     continue
                 dest = pick_replica(rr)
                 if dest is None:
@@ -412,7 +529,8 @@ def run_router(size: int, requests: List[dict],
                 gsrc = plane.members[rank]
                 migrating[gid] = (rank, dest)
                 if send_cmd(rank, {"op": "send_snapshot", "gid": gid,
-                                   "dest": gdest}):
+                                   "dest": gdest,
+                                   "trace": wire_trace(rr)}):
                     send_cmd(dest, {
                         "op": "recv_snapshot", "gid": gid,
                         "source": gsrc,
@@ -422,6 +540,7 @@ def run_router(size: int, requests: List[dict],
                         "stop_token": rr.spec["stop_token"],
                         "timeout_s": rr.spec["timeout_s"],
                         "committed": list(rr.tokens),
+                        "trace": wire_trace(rr),
                     })
             elif kind == "adopted":
                 _, gid = ev
@@ -457,6 +576,7 @@ def run_router(size: int, requests: List[dict],
                 if not rr.done:
                     rr.status = "failed"
                     rr.error = "every replica died"
+                    close_trace(rr)
             break
         for rank in health.check():
             on_dead(rank, "missed heartbeats")
@@ -480,7 +600,12 @@ def run_router(size: int, requests: List[dict],
                     "op": "prefill", "gid": rr.gid,
                     "prompt": list(prompt),
                     "sampling": rr.spec["sampling"],
+                    "trace": wire_trace(rr),
                 }):
+                    if tr is not None and rr.trace is not None:
+                        tr.record_span("placement", rr.trace,
+                                       tr.clock(), 0.0, target=pr,
+                                       kind="prefill")
                     prefilling[rr.gid] = pr
                     rr.status = "prefill"
                     continue
@@ -503,6 +628,8 @@ def run_router(size: int, requests: List[dict],
             reporter.gauge("serving/cluster/replicas_alive", len(alive))
     for rank in sorted(alive):
         send_cmd(rank, {"op": "stop"})
+    for rr in reqs.values():
+        close_trace(rr)  # no-op for roots already ended
     return {
         gid: {
             "tokens": list(rr.tokens),

@@ -25,6 +25,7 @@ import dataclasses
 import time
 from typing import Callable, Dict, List, Optional
 
+from chainermn_tpu.observability import tracing as _tracing
 from chainermn_tpu.serving.engine import SamplingParams
 from chainermn_tpu.serving.scheduler import (
     ContinuousBatchingScheduler,
@@ -57,6 +58,11 @@ class RequestHandle:
     _request: Request
     finished_at: Optional[float] = None
     timed_out: bool = False
+    #: trace id when tracing is active (None otherwise).
+    trace_id: Optional[str] = None
+    #: root span context when THIS frontend minted the root (a handle
+    #: for a request whose root lives in the router carries None here).
+    _trace_root: Optional[_tracing.SpanCtx] = None
 
     @property
     def done(self) -> bool:
@@ -97,10 +103,15 @@ class ServeFrontend:
 
     def __init__(self, scheduler: ContinuousBatchingScheduler,
                  max_queue: int = 64,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 replica=None):
         self.scheduler = scheduler
         self.max_queue = int(max_queue)
         self.clock = clock
+        # Replica id stamped on trace records minted here (the in-
+        # process cluster shares one tracer across replicas, so the
+        # tracer's own default can't attribute them).
+        self.replica = replica if replica is not None else scheduler.replica
         self._handles: Dict[int, RequestHandle] = {}
         self._next_id = 0
         # (timestamp, tokens emitted) per recent step — the decode
@@ -152,6 +163,7 @@ class ServeFrontend:
                timeout_s: Optional[float] = None,
                on_token: Optional[Callable[[int, int], None]] = None,
                committed: Optional[List[int]] = None,
+               trace=None,
                ) -> RequestHandle:
         """Enqueue one request; raises :class:`QueueFull` (with a
         ``retry_after_s`` hint once throughput is known) when the
@@ -163,7 +175,12 @@ class ServeFrontend:
         request so admission re-prefills prompt+committed and sampling
         resumes at the next position, bit-identical to an uninterrupted
         run (counter-based RNG).  ``on_token`` does NOT re-fire for
-        them — the caller already streamed them."""
+        them — the caller already streamed them.
+
+        ``trace`` — parent trace context (a ``SpanCtx`` or its wire
+        dict) when the request's ROOT span is owned elsewhere (the
+        cluster router); with a tracer installed and no parent given,
+        this frontend mints the root here."""
         if self.queue_depth() >= self.max_queue:
             hint = self._retry_after_hint()
             msg = f"waiting queue at capacity ({self.max_queue})"
@@ -187,10 +204,25 @@ class ServeFrontend:
             timeout_s=timeout_s,
             _request=req,
         )
+        tr = _tracing.get_tracer()
+        if tr is not None:
+            parent = _tracing.SpanCtx.from_wire(trace)
+            if parent is None:
+                # This frontend is the entry point: mint the root.
+                handle._trace_root = tr.begin(
+                    "request", replica=self.replica, rid=rid,
+                    prompt_len=len(req.prompt),
+                    max_new_tokens=req.max_new_tokens,
+                )
+                parent = handle._trace_root
+            handle.trace_id = parent.trace_id
+            req.trace = parent
+            req.trace_enq = tr.clock()
         self._handles[rid] = handle
         self.scheduler.add_request(req)
         if req.done:  # rejected at intake (oversized / empty prompt)
             handle.finished_at = handle.submitted_at
+            self._close_trace(handle)
         return handle
 
     def adopt(self, req: Request,
@@ -206,8 +238,23 @@ class ServeFrontend:
             timeout_s=timeout_s,
             _request=req,
         )
+        if req.trace is not None:
+            handle.trace_id = req.trace.trace_id
         self._handles[req.request_id] = handle
         return handle
+
+    def _close_trace(self, h: RequestHandle) -> None:
+        """End the root span for a handle whose root was minted HERE
+        (no-op for router-owned roots).  Idempotent."""
+        root = h._trace_root
+        if root is None:
+            return
+        h._trace_root = None
+        tr = _tracing.get_tracer()
+        if tr is not None:
+            err = h.error
+            tr.end(root, error=err, status=h.status,
+                   tokens=len(h._request.generated))
 
     # -- deadlines -----------------------------------------------------
     def _expire(self, now: float) -> int:
@@ -233,6 +280,7 @@ class ServeFrontend:
             sched._finished[req.request_id] = req
             h.timed_out = True
             h.finished_at = now
+            self._close_trace(h)
         return len(expired)
 
     # -- driving -------------------------------------------------------
@@ -248,6 +296,7 @@ class ServeFrontend:
         for h in self._handles.values():
             if h._request.done and h.finished_at is None:
                 h.finished_at = now
+                self._close_trace(h)
         self._expire(now)
         return emitted
 

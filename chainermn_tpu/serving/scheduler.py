@@ -27,6 +27,7 @@ from collections import deque
 from enum import Enum
 from typing import Callable, Deque, Dict, List, Optional
 
+from chainermn_tpu.observability import tracing as _tracing
 from chainermn_tpu.serving.engine import InferenceEngine, SamplingParams
 from chainermn_tpu.serving.kv_cache import OutOfBlocks
 
@@ -63,6 +64,11 @@ class Request:
     error: Optional[str] = None
     #: host step index at which the first token appeared (TTFT proxy).
     first_token_step: Optional[int] = None
+    #: trace context stage spans parent to (the request's ROOT — see
+    #: the crash-robust parenting rule in observability/tracing.py).
+    trace: Optional[_tracing.SpanCtx] = None
+    #: tracer-clock enqueue time — the pending queue-wait span's start.
+    trace_enq: Optional[float] = None
 
     @property
     def context(self) -> List[int]:
@@ -198,6 +204,12 @@ class ContinuousBatchingScheduler:
         victim.state = RequestState.WAITING
         victim.preemptions += 1
         self.waiting.appendleft(victim)
+        if victim.trace is not None:
+            tr = _tracing.get_tracer()
+            if tr is not None:
+                tr.event("preempted", victim.trace, replica=self.replica,
+                         generated=len(victim.generated))
+                victim.trace_enq = tr.clock()
         if self.reporter is not None:
             self.reporter.count("serving/preemptions", 1)
         return True
@@ -216,10 +228,12 @@ class ContinuousBatchingScheduler:
         self.running.remove(req)
         self._finished[req.request_id] = req
 
-    def _emit(self, req: Request, token: int) -> None:
+    def _emit(self, req: Request, token: int, tr=None) -> None:
         req.generated.append(token)
         if req.first_token_step is None:
             req.first_token_step = self._step
+        if tr is not None and req.trace is not None:
+            tr.token(req.trace)
         if req.on_token is not None:
             req.on_token(req.request_id, token)
 
@@ -230,17 +244,42 @@ class ContinuousBatchingScheduler:
         of tokens emitted this step (0 = idle)."""
         self._step += 1
         emitted = 0
+        # Zero-overhead gate: with no tracer installed (and no request
+        # carrying a context) every tracing branch below is dead.
+        tr = _tracing.get_tracer()
 
         for req in self._admit():
+            traced = tr is not None and req.trace is not None
+            if traced and req.trace_enq is not None:
+                now = tr.clock()
+                tr.record_span(
+                    "queue", req.trace, req.trace_enq,
+                    now - req.trace_enq, replica=self.replica,
+                    depth=len(self.waiting),
+                    preemptions=req.preemptions,
+                )
+                req.trace_enq = None
+            t0 = tr.clock() if traced else 0.0
             try:
                 logits = self.engine.prefill(req.context, req.request_id)
             except ValueError as e:  # oversized prompt and similar
+                if traced:
+                    tr.record_span(
+                        "prefill", req.trace, t0, tr.clock() - t0,
+                        replica=self.replica, error=True,
+                        tokens=len(req.context),
+                    )
                 self._fail(req, str(e))
                 continue
             tok = self.engine.sample(
                 logits, req.sampling, len(req.context)
             )
-            self._emit(req, tok)
+            if traced:
+                tr.record_span(
+                    "prefill", req.trace, t0, tr.clock() - t0,
+                    replica=self.replica, tokens=len(req.context),
+                )
+            self._emit(req, tok, tr)
             emitted += 1
             if req._finish_if_complete():
                 self._retire(req)
@@ -268,6 +307,10 @@ class ContinuousBatchingScheduler:
                     )
         if self.running:
             batch = list(self.running)
+            traced_reqs = [] if tr is None else [
+                r for r in batch if r.trace is not None
+            ]
+            t0 = tr.clock() if traced_reqs else 0.0
             # context[-1] is the token sampled last step but not yet
             # written to the pages — write it at position len-1, then
             # the returned logits predict position len.
@@ -281,10 +324,20 @@ class ContinuousBatchingScheduler:
                 tok = self.engine.sample(
                     logits[i], req.sampling, lens[i] + 1
                 )
-                self._emit(req, tok)
+                self._emit(req, tok, tr)
                 emitted += 1
                 if req._finish_if_complete():
                     self._retire(req)
+            if traced_reqs:
+                # One batched decode iteration serves every traced
+                # request in it; they share the measured duration
+                # (sampling + streaming included).
+                dur = tr.clock() - t0
+                for r in traced_reqs:
+                    tr.record_span(
+                        "decode", r.trace, t0, dur,
+                        replica=self.replica, batch=len(batch),
+                    )
 
         if self.reporter is not None:
             st = self.engine.kv.stats()

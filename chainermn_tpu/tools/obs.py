@@ -17,6 +17,15 @@ Usage::
     # Prometheus textfile (node_exporter textfile-collector format):
     python -m chainermn_tpu.tools.obs prom steps.jsonl -o steps.prom
 
+    # Chrome-trace/Perfetto JSON from serving flight-recorder logs
+    # (stitches span rows across router + replica files; load the
+    # output in chrome://tracing or ui.perfetto.dev):
+    python -m chainermn_tpu.tools.obs trace flight_r*.jsonl -o trace.json
+
+    # postmortem stats instead: per-stage p50/p99, per-trace
+    # connectivity/orphan validation, straggler report:
+    python -m chainermn_tpu.tools.obs trace flight_r*.jsonl --stats
+
 The summary's rank aggregation mirrors the Reporter's reductions: losses
 average across ranks per step (each rank already logs the pmean'd global
 loss, so the aggregate of N rank logs matches a single-process run),
@@ -135,6 +144,41 @@ def summarize(rows: List[dict], curve_points: int = 16) -> dict:
             d["n"] += 1
         out["gauges"] = gauges
 
+    span_rows = [r for r in rows if r.get("event") == "span"
+                 and "dur" in r and "name" in r]
+    if span_rows:
+        from chainermn_tpu.observability.tracing import percentile
+
+        stages: Dict[str, dict] = {}
+        for r in span_rows:
+            d = stages.setdefault(
+                str(r["name"]),
+                {"durs": [], "by_replica": {}},
+            )
+            d["durs"].append(float(r["dur"]))
+            d["by_replica"].setdefault(
+                str(r.get("replica")), []
+            ).append(float(r["dur"]))
+
+        def _pcts(durs):
+            return {
+                "count": len(durs),
+                "p50_s": percentile(durs, 50),
+                "p99_s": percentile(durs, 99),
+            }
+
+        out["trace_stages"] = {
+            name: {
+                **_pcts(d["durs"]),
+                "by_replica": {
+                    rid: _pcts(ds)
+                    for rid, ds in sorted(d["by_replica"].items())
+                },
+            }
+            for name, d in sorted(stages.items())
+        }
+        out["traces"] = len({r.get("trace") for r in span_rows})
+
     audits = [r for r in rows if r.get("event") == "hlo_audit"]
     if audits:
         counts: Dict[str, int] = {}
@@ -166,10 +210,17 @@ def to_prometheus(summary: dict, prefix: str = "chainermn_tpu") -> str:
     """Render a summary as Prometheus textfile metrics (deterministic
     ordering — fit for golden-file tests and textfile collectors)."""
     lines: List[str] = []
+    emitted_headers: set = set()
 
     def metric(name, mtype, help_, samples):
-        lines.append(f"# HELP {prefix}_{name} {help_}")
-        lines.append(f"# TYPE {prefix}_{name} {mtype}")
+        # Prometheus exposition format allows each metric's HELP/TYPE
+        # header at most once per scrape: repeated metric() calls for
+        # the same name (e.g. per-replica labelled series emitted from
+        # several sections) append samples without re-emitting headers.
+        if name not in emitted_headers:
+            emitted_headers.add(name)
+            lines.append(f"# HELP {prefix}_{name} {help_}")
+            lines.append(f"# TYPE {prefix}_{name} {mtype}")
         for labels, value in samples:
             lab = (
                 "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
@@ -230,6 +281,34 @@ def to_prometheus(summary: dict, prefix: str = "chainermn_tpu") -> str:
         metric("gauge_max", "gauge",
                "Most-loaded rank's value per set-style gauge",
                [(labels, v["max"]) for labels, v in samples])
+    tstages = summary.get("trace_stages")
+    if tstages:
+        # Per-stage series overall ({stage="decode"}) AND per replica
+        # ({stage="decode",replica="1"}) — mixed label sets under one
+        # metric name are valid exposition format.
+        def trace_rows(key):
+            rows = []
+            for stage, d in sorted(tstages.items()):
+                rows.append(((("stage", stage),), d[key]))
+                for rid, rd in sorted(d["by_replica"].items()):
+                    rows.append(
+                        ((("stage", stage), ("replica", rid)), rd[key])
+                    )
+            return rows
+
+        metric("trace_spans_total", "counter",
+               "Trace spans recorded per serving stage",
+               trace_rows("count"))
+        metric("trace_stage_p50_seconds", "gauge",
+               "Per-stage span duration p50 derived from traces",
+               trace_rows("p50_s"))
+        metric("trace_stage_p99_seconds", "gauge",
+               "Per-stage span duration p99 derived from traces",
+               trace_rows("p99_s"))
+        if "traces" in summary:
+            metric("traces_total", "counter",
+                   "Distinct request traces in the log window",
+                   [((), summary["traces"])])
     coll = summary.get("collectives")
     if coll:
         metric("collective_ops_total", "counter",
@@ -264,18 +343,74 @@ def main(argv=None) -> int:
     p.add_argument("--prefix", default="chainermn_tpu")
     p.add_argument("--no-rotated", action="store_true")
 
+    t = sub.add_parser(
+        "trace",
+        help="stitch flight-recorder logs into Chrome-trace JSON",
+    )
+    t.add_argument("logs", nargs="+",
+                   help="flight JSONL path(s) — router + replicas")
+    t.add_argument("-o", "--output", default=None,
+                   help="output path (default: stdout)")
+    t.add_argument("--stats", action="store_true",
+                   help="print per-stage percentiles, per-trace "
+                        "validation, and a straggler report instead of "
+                        "the Chrome JSON")
+    t.add_argument("--straggler-k", type=float, default=4.0,
+                   help="flag replicas whose stage median exceeds this "
+                        "multiple of the fleet median")
+    t.add_argument("--no-rotated", action="store_true")
+
     args = ap.parse_args(argv)
     rows = _load(args.logs, include_rotated=not args.no_rotated)
     if args.cmd == "summarize":
         print(json.dumps(summarize(rows, curve_points=args.curve_points)))
         return 0
-    text = to_prometheus(summarize(rows), prefix=args.prefix)
+    if args.cmd == "trace":
+        text = trace_report(rows, stats=args.stats,
+                            straggler_k=args.straggler_k)
+    else:
+        text = to_prometheus(summarize(rows), prefix=args.prefix)
     if args.output:
         with open(args.output, "w") as f:
             f.write(text)
     else:
         sys.stdout.write(text)
     return 0
+
+
+def trace_report(rows: List[dict], stats: bool = False,
+                 straggler_k: float = 4.0) -> str:
+    """The ``trace`` subcommand's engine: Chrome-trace JSON (default)
+    or a postmortem stats report, from raw flight-recorder rows."""
+    from chainermn_tpu.observability import tracing
+
+    recs = [r for r in rows if r.get("event") in ("span", "evt")]
+    if not stats:
+        return json.dumps(tracing.to_chrome_trace(recs)) + "\n"
+    traces = tracing.stitch(recs)
+    vals = [tracing.validate_trace(t["spans"]) for t in traces.values()]
+    stage_stats: Dict[tuple, list] = {}
+    for r in recs:
+        if r.get("event") == "span" and "dur" in r:
+            stage_stats.setdefault(
+                (r.get("replica"), r["name"]), []
+            ).append(float(r["dur"]))
+    stragglers = tracing.detect_stragglers(stage_stats, k=straggler_k)
+    report = {
+        "traces": {
+            "count": len(vals),
+            "connected": sum(v["connected"] for v in vals),
+            "with_orphans": sum(bool(v["orphans"]) for v in vals),
+            "monotone": sum(v["monotone"] for v in vals),
+        },
+        "stages": tracing.stage_percentiles(recs),
+        "stragglers": {
+            str(rep): flags for rep, flags in sorted(
+                stragglers.items(), key=lambda kv: str(kv[0])
+            )
+        },
+    }
+    return json.dumps(report, indent=2) + "\n"
 
 
 if __name__ == "__main__":

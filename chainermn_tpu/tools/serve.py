@@ -24,6 +24,11 @@ Usage::
     # in-process smoke: 2 replicas, oracle parity check
     python -m chainermn_tpu.tools.serve --replicas 2 --verify
 
+    # same, with a Chrome/Perfetto trace of every request
+    python -m chainermn_tpu.tools.serve --replicas 2 \
+        --roles prefill,decode --prefill-threshold 8 \
+        --trace-out /tmp/serve_trace.json
+
     # disaggregated roles: replica 0 prefills, replica 1 decodes
     python -m chainermn_tpu.tools.serve --replicas 2 \
         --roles prefill,decode --prefill-threshold 16
@@ -144,6 +149,53 @@ def _oracle_streams(args, prompts) -> List[List[int]]:
     return [eng.generate(p, args.new_tokens) for p in prompts]
 
 
+def _install_tracer(args):
+    """Install a process-wide tracer when --trace-out/--flight-dir asks
+    for one.  Returns (tracer, uninstall_cb); (None, noop) untraced."""
+    import os
+
+    from chainermn_tpu.observability import tracing
+
+    if not (args.trace_out or args.flight_dir):
+        return None, lambda: None
+    flight = None
+    if args.flight_dir:
+        os.makedirs(args.flight_dir, exist_ok=True)
+        flight = tracing.FlightRecorder(
+            os.path.join(args.flight_dir, "flight_local.jsonl")
+        )
+    tr = tracing.Tracer(flight=flight)
+    tracing.install(tr)
+
+    def done():
+        tracing.uninstall(tr)
+        tr.close()
+
+    return tr, done
+
+
+def _export_trace(args, tr, extra: dict) -> None:
+    """Write the Chrome trace to --trace-out and fold per-stage
+    percentiles into the report."""
+    import json as _json
+
+    from chainermn_tpu.observability import tracing
+
+    recs = tr.records()
+    if args.trace_out:
+        with open(args.trace_out, "w") as f:
+            _json.dump(tracing.to_chrome_trace(recs), f)
+    stages = tracing.stage_percentiles(recs)
+    extra["trace_stages"] = {
+        name: {"count": st["count"], "p50_s": st["p50_s"],
+               "p99_s": st["p99_s"]}
+        for name, st in sorted(stages.items())
+    }
+    extra["traces"] = len({
+        r.get("trace") for r in recs if r.get("trace")
+    })
+
+
 def run_local(args) -> int:
     from chainermn_tpu.serving.cluster import (
         HeartbeatMonitor,
@@ -152,6 +204,7 @@ def run_local(args) -> int:
         ThreadedClusterDriver,
     )
 
+    tr, tr_done = _install_tracer(args)
     factory = _engine_factory(args)
     roles = _parse_roles(args.roles, args.replicas)
     replicas = [
@@ -201,6 +254,9 @@ def run_local(args) -> int:
         ]
         extra["parity"] = "ok" if not mismatches else "FAIL"
         extra["parity_mismatches"] = mismatches
+    if tr is not None:
+        _export_trace(args, tr, extra)
+    tr_done()
     print(json.dumps(_report(args, results, wall, extra)))
     if args.verify and extra["parity"] != "ok":
         return 1
@@ -223,6 +279,17 @@ def _init_distributed(args) -> None:
     )
 
 
+def _flight_path(args) -> Optional[str]:
+    import os
+
+    if not args.flight_dir:
+        return None
+    os.makedirs(args.flight_dir, exist_ok=True)
+    name = ("flight_router.jsonl" if args.role == "router"
+            else f"flight_{args.process_id}.jsonl")
+    return os.path.join(args.flight_dir, name)
+
+
 def run_multiprocess(args) -> int:
     from chainermn_tpu.serving.cluster import service
 
@@ -234,6 +301,7 @@ def run_multiprocess(args) -> int:
             args.process_id, size, _engine_factory(args),
             role=role, max_queue=args.max_queue,
             watermark_blocks=args.watermark,
+            flight_path=_flight_path(args),
         )
         print(json.dumps({"mode": "replica", "rank": args.process_id,
                           **out}))
@@ -253,9 +321,29 @@ def run_multiprocess(args) -> int:
         size, requests,
         prefill_threshold=args.prefill_threshold,
         timeout_s=args.timeout_s,
+        flight_path=_flight_path(args),
     )
     wall = time.perf_counter() - t0
     extra = {}
+    if args.trace_out and args.flight_dir:
+        # Stitch every process's flight log (shared filesystem) into
+        # one Chrome trace — works after crashes too, that's the point.
+        import os
+
+        from chainermn_tpu.observability import tracing
+
+        recs = tracing.read_flight_dir(
+            os.path.join(args.flight_dir, "flight_*.jsonl")
+        )
+        with open(args.trace_out, "w") as f:
+            json.dump(tracing.to_chrome_trace(recs), f)
+        extra["trace_stages"] = {
+            name: {"count": st["count"], "p50_s": st["p50_s"],
+                   "p99_s": st["p99_s"]}
+            for name, st in sorted(
+                tracing.stage_percentiles(recs).items()
+            )
+        }
     if args.verify:
         oracle = _oracle_streams(args, prompts)
         mismatches = [
@@ -299,6 +387,13 @@ def main(argv=None) -> int:
                     help="replay through a sequential oracle and fail "
                          "unless streams are bit-identical")
     ap.add_argument("--timeout-s", type=float, default=120.0)
+    # observability
+    ap.add_argument("--trace-out", default=None,
+                    help="write a Chrome/Perfetto trace JSON of every "
+                         "request's span tree to this path")
+    ap.add_argument("--flight-dir", default=None,
+                    help="directory for crash-surviving flight-recorder "
+                         "logs (one JSONL per process; enables tracing)")
     # traffic
     ap.add_argument("--requests", type=int, default=8)
     ap.add_argument("--prompt-len", type=int, default=12,

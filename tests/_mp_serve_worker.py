@@ -1,6 +1,7 @@
 """Worker for the multi-process serving soak test.
 
-Run as: python _mp_serve_worker.py <pid> <nproc> <port> <kill_after>
+Run as: python _mp_serve_worker.py <pid> <nproc> <port> <kill_after> \
+            [flight_dir]
 
 A REAL serving fleet under one jax.distributed coordinator: rank 0 runs
 the service-loop router (:func:`service.run_router`), every other rank a
@@ -16,6 +17,11 @@ a sequential single-engine oracle.  The survivor's page pool passes
 Rank 0 prints ``SERVE_SOAK_OK`` after verifying all streams; surviving
 replicas print ``SERVE_REPLICA_OK <pid>``.  The killed rank's "output"
 is its -9 exit status.
+
+With a ``flight_dir`` argument every rank records its trace spans to a
+crash-surviving flight file (``flight_<rank>.jsonl``) — the SIGKILLed
+rank's stage spans survive on disk and the host test stitches them into
+the router's root spans for the failover postmortem.
 """
 
 import os
@@ -25,6 +31,10 @@ import sys
 def main():
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     kill_after = int(sys.argv[4])
+    flight_dir = sys.argv[5] if len(sys.argv) > 5 else None
+    flight_path = None
+    if flight_dir:
+        flight_path = os.path.join(flight_dir, f"flight_{pid}.jsonl")
 
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -73,6 +83,7 @@ def main():
         # faster via socket EOF -> PeerGone on the event edge.
         results = service.run_router(
             nproc, requests, miss_after_s=30.0, timeout_s=180.0,
+            flight_path=flight_path,
         )
         try:
             oracle = engine_factory()
@@ -105,6 +116,7 @@ def main():
     out = service.run_replica(
         pid, nproc, engine_factory, max_queue=3,
         kill_after_tokens=kill_after if doomed else None,
+        flight_path=flight_path,
     )
     print(f"SERVE_REPLICA_OK {pid} {out['reason']}")
     sys.stdout.flush()

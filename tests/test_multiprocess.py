@@ -294,14 +294,21 @@ _SERVE_WORKER = os.path.join(
 )
 
 
-def test_serving_cluster_survives_replica_kill9():
+def test_serving_cluster_survives_replica_kill9(tmp_path):
     """The serving-fleet soak: router + 2 replica processes, the highest
     rank SIGKILLed mid-stream with live sequences in its pool.  Every
     request must still finish with a token stream bit-identical to the
     sequential single-engine oracle (failover re-prefills from the
     committed prefix), and the survivor's page pool must pass
-    assert_consistent on clean stop."""
-    procs, outs = _launch(_SERVE_WORKER, 3, "5", n_devices=1, timeout=420)
+    assert_consistent on clean stop.
+
+    Every rank also records to a flight file; the postmortem below
+    stitches the dead rank's on-disk spans into the router's root spans
+    and requires a coherent story: no orphans, monotone timestamps, a
+    failover event, and the resumed request showing work from BOTH the
+    killed and the adopting replica."""
+    procs, outs = _launch(_SERVE_WORKER, 3, "5", str(tmp_path),
+                          n_devices=1, timeout=420)
     codes = [p.returncode for p in procs]
     assert codes[2] == -9, f"rank 2 should die by SIGKILL: {codes}\n" \
         + "\n".join(outs)
@@ -309,6 +316,33 @@ def test_serving_cluster_survives_replica_kill9():
     assert "SERVE_SOAK_OK" in outs[0], outs[0]
     assert codes[1] == 0, f"survivor replica failed:\n{outs[1]}"
     assert "SERVE_REPLICA_OK 1" in outs[1], outs[1]
+
+    # -- flight-recorder postmortem ------------------------------------
+    from chainermn_tpu.observability import tracing
+
+    rows = tracing.read_flight_dir(str(tmp_path / "flight_*.jsonl"))
+    assert rows, "no flight records survived"
+    trees = tracing.stitch(rows)
+    assert len(trees) == 6  # one trace per request, none lost
+    crossed = []
+    for tid, t in trees.items():
+        v = tracing.validate_trace(t["spans"])
+        # the SIGKILLed rank only ever wrote CLOSED spans parented to
+        # the router-owned root: nothing may dangle, clocks line up
+        assert not v["orphans"], (tid, v)
+        assert v["connected"], (tid, v)
+        assert v["monotone"], (tid, v)
+        reps = {s.get("replica") for s in t["spans"]}
+        if {1, 2} <= reps:
+            crossed.append(tid)
+    # at least one stream was cut on rank 2 and adopted by rank 1 —
+    # its single trace carries both replicas' spans
+    assert crossed, sorted(
+        (tid, sorted(str(s.get("replica")) for s in t["spans"]))
+        for tid, t in trees.items()
+    )
+    evts = [r for r in rows if r.get("event") == "evt"]
+    assert any(r["name"] == "failover" for r in evts), evts
 
 
 def test_serving_cluster_clean_run_no_kill():

@@ -1,0 +1,465 @@
+"""Cross-replica request tracing: span trees, the crash-surviving
+flight recorder, Chrome-trace export, SLO burn gauges, stragglers.
+
+The contract under test, layer by layer:
+
+1. **Tracer core** — begin/end produce span rows only at ``end`` (the
+   crash-robustness rule: an open span is never on disk, so a SIGKILLed
+   process loses open spans but never writes a dangling child);
+   ``span()`` closes and marks ``error`` on exceptions; double-``end``
+   is a no-op; ``token()`` arrivals become the derived ``deliver`` span
+   when the root closes.
+2. **FlightRecorder** — O_APPEND JSONL that tolerates a torn final
+   line (the SIGKILL tail) and skips rotated files on directory reads.
+3. **Stitch/validate/export** — orphan detection, timestamp
+   monotonicity, and the Chrome-trace JSON schema (golden file).
+4. **Serving integration** — a disaggregated 2-replica run yields one
+   CONNECTED tree per request with every stage span present, and
+   tracing adds ZERO compiles (it never touches jit inputs).
+5. **Fleet health** — SLO burn-rate gauges and the straggler detector.
+
+All CPU, in-process.  The cross-process SIGKILL postmortem soaks in
+tests/test_multiprocess.py.
+"""
+
+import json
+import os
+
+import pytest
+
+from chainermn_tpu.observability import tracing
+from chainermn_tpu.observability.reporter import Reporter
+from chainermn_tpu.observability.tracing import (
+    FlightRecorder,
+    SLOConfig,
+    SpanCtx,
+    Tracer,
+    detect_stragglers,
+    read_flight,
+    read_flight_dir,
+    stage_percentiles,
+    stitch,
+    to_chrome_trace,
+    validate_trace,
+)
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "serve_trace.json")
+
+
+def make_tracer(**kw):
+    """Deterministic tracer: fake monotonic clock, fixed id nonce."""
+    clock = {"t": 1000.0}
+
+    def tick():
+        clock["t"] += 0.001
+        return clock["t"]
+
+    kw.setdefault("nonce", "g")
+    tr = Tracer(clock=tick, **kw)
+    return tr, clock
+
+
+# ---------------------------------------------------------------------------
+# tracer core
+# ---------------------------------------------------------------------------
+
+def test_begin_end_emits_rows_only_at_end():
+    tr, _ = make_tracer()
+    root = tr.begin("request", rid=1)
+    assert tr.records() == []          # open spans live in memory only
+    assert tr.open_count() == 1
+    tr.end(root, status="finished")
+    rows = tr.records()
+    assert [r["name"] for r in rows] == ["request"]
+    assert rows[0]["event"] == "span"
+    assert rows[0]["trace"] == root.trace_id
+    assert rows[0]["parent"] is None
+    assert tr.open_count() == 0
+
+
+def test_double_end_is_noop():
+    tr, _ = make_tracer()
+    ctx = tr.begin("request")
+    tr.end(ctx)
+    tr.end(ctx)
+    assert len(tr.records()) == 1
+
+
+def test_span_contextmanager_closes_and_marks_error():
+    tr, _ = make_tracer()
+    root = tr.begin("request")
+    with pytest.raises(RuntimeError):
+        with tr.span("prefill", parent=root, replica=0):
+            raise RuntimeError("page fault")
+    tr.end(root)
+    rows = {r["name"]: r for r in tr.records()}
+    assert rows["prefill"]["error"] is True
+    assert "page fault" in rows["prefill"]["attrs"]["error_msg"]
+    assert tr.open_count() == 0        # nothing leaked open
+
+
+def test_token_arrivals_become_deliver_span():
+    tr, _ = make_tracer()
+    root = tr.begin("request")
+    tr.token(root)
+    tr.token(root)
+    tr.token(root)
+    tr.end(root, tokens=3)
+    rows = {r["name"]: r for r in tr.records()}
+    d = rows["deliver"]
+    assert d["attrs"]["tokens"] == 3
+    assert d["parent"] == root.span_id
+    assert d["dur"] == pytest.approx(0.002, abs=1e-6)
+
+
+def test_record_span_and_event_parent_to_wire_ctx():
+    tr, _ = make_tracer()
+    root = tr.begin("request")
+    wire = SpanCtx.from_wire(root.to_wire())   # the CMD-frame round trip
+    assert wire.trace_id == root.trace_id
+    tr.record_span("queue", wire, 1000.0, 0.5, replica=2, depth=3)
+    tr.event("preempted", wire, replica=2)
+    tr.end(root)
+    rows = tr.records()
+    by = {r["name"]: r for r in rows}
+    assert by["queue"]["parent"] == root.span_id
+    assert by["queue"]["replica"] == 2
+    assert by["preempted"]["event"] == "evt"
+    # untraced request: ctx None is a no-op, not an error
+    tr.record_span("queue", None, 0.0, 0.1)
+    tr.event("preempted", None)
+    assert len(tr.records()) == len(rows)
+
+
+def test_nothing_recorded_when_uninstalled():
+    assert tracing.get_tracer() is None
+    ctx = tracing.SpanCtx.from_wire(None)
+    assert ctx is None
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_recorder_roundtrip_and_torn_tail(tmp_path):
+    p = tmp_path / "flight_0.jsonl"
+    tr, _ = make_tracer(flight=FlightRecorder(str(p), replica=0),
+                        replica=0)
+    root = tr.begin("request", rid=7)
+    tr.record_span("prefill", root, 1000.0, 0.25, tokens=8)
+    tr.end(root, status="finished")
+    tr.close()
+    # simulate the SIGKILL torn tail: a half-written final line
+    with open(p, "a") as f:
+        f.write('{"event": "span", "name": "dec')
+    rows = read_flight(str(p))
+    assert [r["name"] for r in rows] == ["prefill", "request"]
+    assert all(r["replica"] == 0 for r in rows)
+
+
+def test_read_flight_dir_merges_and_skips_rotated(tmp_path):
+    a = tmp_path / "flight_0.jsonl"
+    b = tmp_path / "flight_1.jsonl"
+    for path, rep in ((a, 0), (b, 1)):
+        tr, _ = make_tracer(flight=FlightRecorder(str(path),
+                                                  replica=rep),
+                            replica=rep, nonce=f"n{rep}")
+        root = tr.begin("request")
+        tr.end(root)
+        tr.close()
+    # a rotated shard folds into its parent log — and must not be
+    # double-read even when the glob matches it directly
+    (tmp_path / "flight_0.jsonl.1").write_text(
+        json.dumps({"event": "span", "trace": "tx", "span": "x",
+                    "parent": None, "name": "request", "t0": 1.0,
+                    "dur": 1.0, "replica": 9}) + "\n"
+    )
+    rows = read_flight_dir(str(tmp_path / "flight_*"))
+    assert sorted({r["replica"] for r in rows}) == [0, 1, 9]
+    assert sum(1 for r in rows if r["replica"] == 9) == 1
+
+
+# ---------------------------------------------------------------------------
+# stitch / validate / percentiles
+# ---------------------------------------------------------------------------
+
+def _rows(*triples):
+    out = []
+    for name, sid, parent in triples:
+        # the root (parent None) encloses everything; children nest
+        dur = 100.0 if parent is None else 0.5
+        out.append({"event": "span", "trace": "t1", "span": sid,
+                    "parent": parent, "name": name,
+                    "t0": 1000.0 + len(out), "dur": dur, "replica": 0})
+    return out
+
+
+def test_validate_flags_orphans():
+    good = _rows(("request", "r", None), ("queue", "q", "r"))
+    v = validate_trace(stitch(good)["t1"]["spans"])
+    assert v["connected"] and not v["orphans"] and v["monotone"]
+
+    bad = _rows(("request", "r", None), ("queue", "q", "GONE"))
+    v = validate_trace(stitch(bad)["t1"]["spans"])
+    assert not v["connected"]
+    assert v["orphans"] == ["q"]
+
+
+def test_validate_flags_nonmonotone_child():
+    rows = _rows(("request", "r", None))
+    rows.append({"event": "span", "trace": "t1", "span": "q",
+                 "parent": "r", "name": "queue", "t0": 10.0,
+                 "dur": 0.1, "replica": 0})  # starts before the root
+    v = validate_trace(stitch(rows)["t1"]["spans"])
+    assert not v["monotone"]
+    assert v["violations"]
+
+
+def test_stage_percentiles_nearest_rank():
+    rows = [
+        {"event": "span", "trace": f"t{i}", "span": f"s{i}",
+         "parent": None, "name": "decode", "t0": 0.0,
+         "dur": (i + 1) / 100.0, "replica": 0}
+        for i in range(100)
+    ]
+    st = stage_percentiles(rows)["decode"]
+    assert st["count"] == 100
+    assert st["p50_s"] == pytest.approx(0.50)
+    assert st["p99_s"] == pytest.approx(0.99)
+
+
+# ---------------------------------------------------------------------------
+# SLO burn + stragglers
+# ---------------------------------------------------------------------------
+
+def test_slo_burn_rate_gauges():
+    rep = Reporter()
+    tr, _ = make_tracer(
+        reporter=rep,
+        slo=SLOConfig(targets={"decode": 0.01}, budget=0.01, window=8),
+    )
+    root = tr.begin("request")
+    for i in range(8):
+        # half the window violates the 10ms decode objective
+        tr.record_span("decode", root, 0.0, 0.5 if i % 2 else 0.001,
+                       replica=0)
+    tr.end(root)
+    s = rep.summary()
+    assert s["counters"]["slo/violations/decode"] == 4
+    # violating fraction 0.5 over budget 0.01 → burn rate 50x
+    assert s["gauges"]["slo/burn_rate/decode"]["value"] == \
+        pytest.approx(50.0)
+    # stage histograms ride along for the Prometheus path
+    assert any(k.startswith("trace/decode") for k in s["histograms"])
+
+
+def test_detect_stragglers_flags_slow_replica():
+    stats = {}
+    for rep in (0, 1, 2):
+        base = 10.0 if rep == 2 else 0.01
+        stats[(rep, "decode")] = [base] * 8
+        stats[(rep, "prefill")] = [0.02] * 8
+    flagged = detect_stragglers(stats, k=4.0, min_samples=4)
+    assert set(flagged) == {2}
+    assert flagged[2]["decode"] > 4.0
+    # a single-replica fleet has no peer baseline — never flags
+    assert detect_stragglers({(0, "decode"): [9.9] * 8}) == {}
+
+
+# ---------------------------------------------------------------------------
+# chrome export (golden schema)
+# ---------------------------------------------------------------------------
+
+def _synthetic_serve_records():
+    """A deterministic disagg-shaped request: router root + placement,
+    prefill on replica 0, handoff + decode on replica 1, a preemption
+    instant, tokens → deliver.  Fixed clock and nonce make every id and
+    timestamp reproducible, so the export can be compared whole."""
+    tr, clock = make_tracer(replica="router")
+    root = tr.begin("request", rid=0, prompt_len=9, max_new_tokens=3)
+    tr.record_span("placement", root, 1000.002, 0.001,
+                   replica="router", target=0, kind="prefill")
+    tr.record_span("queue", root, 1000.003, 0.004, replica=0, depth=1)
+    tr.record_span("prefill", root, 1000.008, 0.050, replica=0,
+                   tokens=9, disagg=True)
+    tr.record_span("handoff", root, 1000.060, 0.010, replica=1,
+                   tokens=10)
+    tr.event("preempted", root, replica=1, generated=1)
+    tr.token(root)
+    tr.record_span("decode", root, 1000.080, 0.005, replica=1, batch=2)
+    tr.token(root)
+    tr.record_span("decode", root, 1000.090, 0.005, replica=1, batch=2)
+    tr.token(root)
+    tr.end(root, status="finished", tokens=3)
+    return tr.records()
+
+
+def test_chrome_trace_golden():
+    doc = to_chrome_trace(_synthetic_serve_records())
+    with open(GOLDEN) as f:
+        want = json.load(f)
+    assert doc == want
+
+
+def test_chrome_trace_schema_invariants():
+    doc = to_chrome_trace(_synthetic_serve_records())
+    evs = doc["traceEvents"]
+    assert doc["displayTimeUnit"] == "ms"
+    phases = {e["ph"] for e in evs}
+    assert phases == {"M", "X", "i"}
+    # one process-name metadata row per replica, stable pid mapping
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert len(meta) == len({e["pid"] for e in evs})
+    spans = [e for e in evs if e["ph"] == "X"]
+    assert all({"name", "ts", "dur", "pid", "tid", "args"} <= set(e)
+               for e in spans)
+    # every span of one trace shares a tid; ts/dur are microseconds
+    tids = {e["args"]["trace"]: e["tid"] for e in spans}
+    assert len(set(tids.values())) == len(tids)
+
+
+# ---------------------------------------------------------------------------
+# prometheus export of trace series
+# ---------------------------------------------------------------------------
+
+def test_prometheus_trace_series_and_header_dedupe(tmp_path):
+    from chainermn_tpu.tools.obs import summarize, to_prometheus
+
+    rows = _synthetic_serve_records()
+    # plus a second replica's decode so per-replica labels materialize
+    rows.append({"event": "span", "trace": "tg.2", "span": "x1",
+                 "parent": "g.1", "name": "decode", "t0": 1000.1,
+                 "dur": 0.004, "replica": 0})
+    text = to_prometheus(summarize(rows), prefix="t")
+    lines = text.splitlines()
+    helps = [l for l in lines if l.startswith("# HELP")]
+    # satellite: headers are emitted at most once per metric name
+    assert len(helps) == len({l.split()[2] for l in helps})
+    assert any(l.startswith('t_trace_spans_total{stage="decode"}')
+               for l in lines)
+    assert any('stage="decode",replica="1"' in l for l in lines)
+    assert any(l.startswith("t_trace_stage_p99_seconds") for l in lines)
+    assert any(l.startswith("t_traces_total 1") for l in lines)
+
+
+# ---------------------------------------------------------------------------
+# serving integration (real engines, CPU)
+# ---------------------------------------------------------------------------
+
+VOCAB = 32
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    return TransformerLM(vocab=VOCAB, d_model=16, n_heads=2, d_ff=32,
+                         n_layers=2, max_len=64)
+
+
+@pytest.fixture(scope="module")
+def lm_params(lm):
+    import jax
+    import jax.numpy as jnp
+
+    return lm.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def make_engine(lm, lm_params, **over):
+    from chainermn_tpu.serving import EngineConfig, InferenceEngine
+
+    cfg = dict(block_size=4, n_blocks=64, max_len=64, max_batch=4)
+    cfg.update(over)
+    return InferenceEngine(lm, lm_params, EngineConfig(**cfg))
+
+
+def prompts_for(n, rng_seed=7, lo=3, hi=13):
+    import numpy as np
+
+    rng = np.random.default_rng(rng_seed)
+    return [
+        [int(t) for t in rng.integers(0, VOCAB, size=int(l))]
+        for l in rng.integers(lo, hi, size=n)
+    ]
+
+
+def _drive(router, prompts, new_tokens=4):
+    handles = [router.submit(p, new_tokens) for p in prompts]
+    for _ in range(3000):
+        router.step()
+        if all(h.done for h in handles):
+            break
+    assert all(h.status == "finished" for h in handles)
+    return handles
+
+
+def test_cluster_disagg_traces_connected(lm, lm_params):
+    from chainermn_tpu.serving.cluster import Replica, ReplicaRouter
+
+    tr, _ = make_tracer()
+    tracing.install(tr)
+    try:
+        reps = [
+            Replica(0, make_engine(lm, lm_params), role="prefill"),
+            Replica(1, make_engine(lm, lm_params), role="decode"),
+        ]
+        router = ReplicaRouter(reps, prefill_threshold=8)
+        # a guaranteed mix: two short prompts decode locally (queue +
+        # local prefill spans), two long ones disaggregate (prefill on
+        # replica 0, handoff to replica 1)
+        prompts = (prompts_for(2, lo=3, hi=6)
+                   + prompts_for(2, rng_seed=8, lo=9, hi=13))
+        handles = _drive(router, prompts)
+    finally:
+        tracing.uninstall(tr)
+    assert all(h.trace_id for h in handles)
+    assert tr.open_count() == 0
+    recs = tr.records()
+    tr.close()
+    trees = stitch(recs)
+    assert len(trees) == len(prompts)
+    names = set()
+    for t in trees.values():
+        v = validate_trace(t["spans"])
+        assert v["connected"] and not v["orphans"], v
+        assert v["monotone"], v
+        names |= {s["name"] for s in t["spans"]}
+    # short prompts decode locally, long ones disagg through handoff
+    assert {"request", "queue", "prefill", "decode", "handoff",
+            "deliver", "placement"} <= names
+    # every request delivered all its tokens through the deliver span
+    delivers = [s for t in trees.values() for s in t["spans"]
+                if s["name"] == "deliver"]
+    assert all(d["attrs"]["tokens"] == 4 for d in delivers)
+
+
+def test_tracing_adds_zero_compiles_and_same_streams(lm, lm_params):
+    """The zero-overhead contract: identical token streams and IDENTICAL
+    compile counts with tracing on vs off — span bookkeeping must never
+    reach jit inputs."""
+    from chainermn_tpu.serving.cluster import Replica, ReplicaRouter
+
+    prompts = prompts_for(3, rng_seed=11)
+
+    def run(traced):
+        tr = None
+        if traced:
+            tr, _ = make_tracer()
+            tracing.install(tr)
+        try:
+            rep = Replica(0, make_engine(lm, lm_params), role="both")
+            router = ReplicaRouter([rep])
+            handles = _drive(router, prompts)
+        finally:
+            if tr is not None:
+                tracing.uninstall(tr)
+                tr.close()
+        st = rep.engine.stats()
+        return ([h.tokens for h in handles],
+                st["prefill_compiles"], st["decode_compiles"])
+
+    off_streams, off_pc, off_dc = run(traced=False)
+    on_streams, on_pc, on_dc = run(traced=True)
+    assert on_streams == off_streams
+    assert (on_pc, on_dc) == (off_pc, off_dc)
