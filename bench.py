@@ -479,8 +479,14 @@ def bench_serve(comm, args):
     )
 
     P, N = args.serve_prompt_len, args.serve_new_tokens
+    dup = min(max(args.serve_prefix_dup, 0.0), 1.0)
+    # --serve-prefix-dup D: the leading D-fraction of every prompt is a
+    # shared template (the few-shot-system-prompt workload the prefix
+    # cache exists for); 0 keeps every prompt fully random.
+    shared = rng.randint(0, cfg["vocab"], size=int(P * dup)).tolist()
     prompts = [
-        rng.randint(0, cfg["vocab"], size=P).tolist()
+        shared + rng.randint(0, cfg["vocab"],
+                             size=P - len(shared)).tolist()
         for _ in range(args.serve_requests)
     ]
     batch_sizes = [int(b) for b in args.serve_batch_sizes.split(",")]
@@ -491,70 +497,16 @@ def bench_serve(comm, args):
 
     sweep = []
     for bs in batch_sizes:
-        ecfg = EngineConfig(
-            block_size=args.serve_block_size,
-            n_blocks=args.serve_blocks,
-            max_len=args.serve_max_len,
-            max_batch=bs,
-        )
-        engine = InferenceEngine(model, params, ecfg)
-        sched = ContinuousBatchingScheduler(engine)
-        fe = ServeFrontend(sched, max_queue=args.serve_queue)
-
-        # warmup: compile the buckets this sweep point will touch
-        fe.submit(prompts[0], N, sampling=SamplingParams())
-        fe.run_until_idle()
-
-        stamps = {}  # request_id -> [perf_counter per token]
-
-        def on_token(rid, tok, _s=stamps):
-            _s.setdefault(rid, []).append(time.perf_counter())
-
-        handles = []
-        t0 = time.perf_counter()
-        for p in prompts:
-            while True:
-                try:
-                    handles.append(
-                        fe.submit(p, N, sampling=SamplingParams(),
-                                  on_token=on_token)
-                    )
-                    break
-                except QueueFull:
-                    # bounded --serve-queue: drain by stepping (the
-                    # bench IS the only driver; sleeping would just
-                    # stall the engine the hint is waiting on)
-                    fe.step()
-        fe.run_until_idle()
-        wall = time.perf_counter() - t0
-
-        total_tokens = sum(len(h.tokens) for h in handles)
-        gaps = []
-        for ts in stamps.values():
-            gaps.extend(b - a for a, b in zip(ts, ts[1:]))
-        gaps.sort()
-
-        def pct(q):
-            if not gaps:
-                return None
-            return gaps[min(len(gaps) - 1, int(q * len(gaps)))]
-
-        st = engine.stats()
-        res = sched.results()
-        sweep.append({
-            "batch_size": bs,
-            "tokens_per_sec": round(total_tokens / wall, 1),
-            "p50_token_latency_ms": round(pct(0.50) * 1e3, 3)
-            if gaps else None,
-            "p99_token_latency_ms": round(pct(0.99) * 1e3, 3)
-            if gaps else None,
-            "requests": len(handles),
-            "finished": sum(1 for h in handles
-                            if h.status == "finished"),
-            "preemptions": sum(r.preemptions for r in res.values()),
-            "prefill_compiles": st["prefill_compiles"],
-            "decode_compiles": st["decode_compiles"],
-        })
+        # A/B at every sweep point: speculative decoding ON vs OFF on
+        # identical traffic (greedy, so the streams are bit-identical —
+        # only the wall clock may differ).
+        on = _serve_sweep_point(args, model, params, prompts, bs,
+                                spec_tokens=args.serve_spec_tokens)
+        off = _serve_sweep_point(args, model, params, prompts, bs,
+                                 spec_tokens=0)
+        on["tokens_per_sec_no_spec"] = off["tokens_per_sec"]
+        on["p99_no_spec_ms"] = off["p99_token_latency_ms"]
+        sweep.append(on)
 
     best = max(sweep, key=lambda r: r["tokens_per_sec"])
     out = {
@@ -569,12 +521,125 @@ def bench_serve(comm, args):
                    "n_requests": args.serve_requests,
                    "block_size": args.serve_block_size,
                    "n_blocks": args.serve_blocks,
-                   "max_queue": args.serve_queue},
+                   "max_queue": args.serve_queue,
+                   "prefix_dup": dup,
+                   "spec_tokens": args.serve_spec_tokens},
         "sweep": sweep,
     }
+    if dup > 0:
+        # The acceptance number for prefix sharing: same traffic, same
+        # batch size, prefix cache disabled — the sharing speedup is
+        # value / baseline.
+        base = _serve_sweep_point(
+            args, model, params, prompts, best["batch_size"],
+            spec_tokens=args.serve_spec_tokens, prefix_cache=False,
+        )
+        out["no_sharing_baseline"] = {
+            "tokens_per_sec": base["tokens_per_sec"],
+            "p99_token_latency_ms": base["p99_token_latency_ms"],
+            "speedup": round(
+                best["tokens_per_sec"]
+                / max(base["tokens_per_sec"], 1e-9), 3),
+        }
     if args.serve_replicas > 1:
         out["cluster"] = bench_serve_cluster(args, model, params)
     return out
+
+
+def _serve_sweep_point(args, model, params, prompts, bs, *,
+                       spec_tokens, prefix_cache=True):
+    """One measured serving run: fresh engine at decode batch ``bs``,
+    all ``prompts`` through the queue frontend, tokens/sec plus
+    per-token latency percentiles and the prefix/speculation counters.
+    """
+    from chainermn_tpu.serving import (
+        ContinuousBatchingScheduler,
+        EngineConfig,
+        InferenceEngine,
+        QueueFull,
+        SamplingParams,
+        ServeFrontend,
+    )
+
+    N = args.serve_new_tokens
+    ecfg = EngineConfig(
+        block_size=args.serve_block_size,
+        n_blocks=args.serve_blocks,
+        max_len=args.serve_max_len,
+        max_batch=bs,
+        prefix_cache=prefix_cache,
+    )
+    engine = InferenceEngine(model, params, ecfg)
+    sched = ContinuousBatchingScheduler(engine, spec_tokens=spec_tokens)
+    fe = ServeFrontend(sched, max_queue=args.serve_queue)
+
+    # warmup: compile the buckets this sweep point will touch (and,
+    # with sharing on, seed the prefix index the way a warm replica is)
+    fe.submit(prompts[0], N, sampling=SamplingParams())
+    fe.run_until_idle()
+
+    stamps = {}  # request_id -> [perf_counter per token]
+
+    def on_token(rid, tok, _s=stamps):
+        _s.setdefault(rid, []).append(time.perf_counter())
+
+    handles = []
+    t0 = time.perf_counter()
+    for p in prompts:
+        while True:
+            try:
+                handles.append(
+                    fe.submit(p, N, sampling=SamplingParams(),
+                              on_token=on_token)
+                )
+                break
+            except QueueFull:
+                # bounded --serve-queue: drain by stepping (the
+                # bench IS the only driver; sleeping would just
+                # stall the engine the hint is waiting on)
+                fe.step()
+    fe.run_until_idle()
+    wall = time.perf_counter() - t0
+
+    total_tokens = sum(len(h.tokens) for h in handles)
+    gaps = []
+    for ts in stamps.values():
+        gaps.extend(b - a for a, b in zip(ts, ts[1:]))
+    gaps.sort()
+
+    def pct(q):
+        if not gaps:
+            return None
+        return gaps[min(len(gaps) - 1, int(q * len(gaps)))]
+
+    st = engine.stats()
+    res = sched.results()
+    row = {
+        "batch_size": bs,
+        "tokens_per_sec": round(total_tokens / wall, 1),
+        "p50_token_latency_ms": round(pct(0.50) * 1e3, 3)
+        if gaps else None,
+        "p99_token_latency_ms": round(pct(0.99) * 1e3, 3)
+        if gaps else None,
+        "requests": len(handles),
+        "finished": sum(1 for h in handles
+                        if h.status == "finished"),
+        "preemptions": sum(r.preemptions for r in res.values()),
+        "prefill_compiles": st["prefill_compiles"],
+        "decode_compiles": st["decode_compiles"],
+        "chunk_compiles": st["chunk_compiles"],
+        "spec_tokens": spec_tokens,
+        "prefix_cache": prefix_cache,
+        "tokens_prefix_cached": st["tokens_prefix_cached"],
+        "cow_splits": st["cow_splits"],
+    }
+    if sched._prefix_lookup_tokens:
+        row["prefix_hit_rate"] = round(
+            sched._prefix_hit_tokens / sched._prefix_lookup_tokens, 4)
+    if sched._spec_rows:
+        row["spec_accept_len"] = round(
+            sched._spec_emitted / sched._spec_rows, 3)
+    return row
 
 
 def _bench_serve_traced(args, model, params, best, prompts):
@@ -871,6 +936,15 @@ def main(argv=None):
     ap.add_argument("--serve-queue", type=int, default=None,
                     help="bounded frontend queue size per "
                          "replica/engine (default: fits all requests)")
+    ap.add_argument("--serve-prefix-dup", type=float, default=0.0,
+                    help="fraction of each prompt drawn from a shared "
+                         "template (duplicate-prefix load for the "
+                         "prefix cache); >0 also reports the "
+                         "no-sharing baseline and speedup")
+    ap.add_argument("--serve-spec-tokens", type=int, default=3,
+                    help="speculative draft length for the serve "
+                         "sweep's spec-ON column (OFF column always "
+                         "runs alongside)")
     ap.add_argument("--no-overlap", action="store_true",
                     help="pin the eager pack-all-then-reduce-all "
                          "gradient schedule (overlap=False on the "

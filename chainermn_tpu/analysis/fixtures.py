@@ -1,7 +1,9 @@
 """Seeded-violation fixtures: one deliberately broken program per rule,
-plus the clean train step none of them may flag — and two deliberately
+plus the clean train step none of them may flag — and three deliberately
 CLEAN entries (``expect=None``): ``serving_decode`` pinning that the
-serving engine's decode step stays collective-free, and
+serving engine's decode step stays collective-free, ``serving_verify``
+pinning the same for the multi-token speculative-verify / prefix-hit
+chunk step, and
 ``overlap_async_pairs`` pinning that R004 reads a compiled overlapped
 schedule's ``all-reduce-start``/``-done`` pairs as ONE collective each
 instead of misdiagnosing them as a bucketing regression.
@@ -209,6 +211,50 @@ def fixture_overlap_async_pairs() -> dict:
     )
 
 
+def fixture_serving_verify() -> dict:
+    """The serving engine's jitted multi-token CHUNK step — the program
+    that verifies speculative drafts and prefills the unshared suffix
+    after a prefix-cache hit.  A CLEAN fixture (``expect=None``) for the
+    same reason as ``serving_decode``: attention over paged KV is
+    per-sequence, so the verify pass must stay collective-free no matter
+    how many draft tokens ride in one row; speculative decoding may
+    never buy latency by smuggling a cross-device reduction into the
+    decode plane."""
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    geom = dict(vocab=32, d_model=16, n_heads=2, d_ff=32, n_layers=1,
+                max_len=16, page_count=8, page_size=4)
+    model = TransformerLM(**geom, paged="chunk")
+    B, T, W = 2, 4, 4
+    tokens = jnp.zeros((B, T), jnp.int32)
+    tables = jnp.zeros((B, W), jnp.int32)
+    starts = jnp.zeros((B,), jnp.int32)
+    offs = starts[:, None] + jnp.arange(T)[None, :]
+    variables = model.init(
+        jax.random.PRNGKey(0), tokens,
+        position_offset=offs, block_tables=tables,
+        seq_lens=starts,
+    )
+    params, cache = variables["params"], variables["cache"]
+
+    def verify_step(params, cache, tokens, tables, starts):
+        offs = (jnp.maximum(starts, 0)[:, None]
+                + jnp.arange(tokens.shape[1])[None, :])
+        logits, upd = model.apply(
+            {"params": params, "cache": cache}, tokens,
+            position_offset=offs, block_tables=tables,
+            seq_lens=starts, mutable=["cache"],
+        )
+        return logits.astype(jnp.float32), upd["cache"]
+
+    return dict(
+        target="serving_verify", expect=None,
+        fn=jax.jit(verify_step, donate_argnums=(1,)),
+        args=(params, cache, tokens, tables, starts), kwargs={},
+        comm=None,
+    )
+
+
 def fixture_serving_decode() -> dict:
     """The serving engine's jitted single-token decode step — a CLEAN
     fixture (``expect=None``): the decode data plane must stay
@@ -259,6 +305,7 @@ FIXTURES: Dict[str, Callable[[], dict]] = {
     "r005": fixture_r005,
     "overlap_async_pairs": fixture_overlap_async_pairs,
     "serving_decode": fixture_serving_decode,
+    "serving_verify": fixture_serving_verify,
 }
 
 

@@ -84,7 +84,9 @@ class MultiHeadAttention(nn.Module):
             # (and the same param structure) as the dense decode path
             # below, so trained params drop in unchanged.
             from chainermn_tpu.ops.decode_attention import (
+                paged_attention_chunk,
                 paged_attention_decode,
+                write_chunk_pages,
                 write_prompt_pages,
                 write_token_pages,
             )
@@ -101,9 +103,9 @@ class MultiHeadAttention(nn.Module):
                     "pluggable adapters ignore the cache mask and would "
                     "attend to the wrong page slots"
                 )
-            if self.paged not in ("prefill", "decode"):
+            if self.paged not in ("prefill", "decode", "chunk"):
                 raise ValueError(
-                    f"paged must be 'prefill' or 'decode', got "
+                    f"paged must be 'prefill', 'decode' or 'chunk', got "
                     f"{self.paged!r}"
                 )
             if self.page_count <= 0 or self.page_size <= 0:
@@ -131,6 +133,30 @@ class MultiHeadAttention(nn.Module):
                 pv.value = write_prompt_pages(
                     pv.value, v, block_tables, seq_lens
                 )
+            elif self.paged == "chunk":
+                # Verify/suffix-prefill mode: T consecutive tokens per
+                # sequence starting at position ``seq_lens[b]`` (here the
+                # context length BEFORE the chunk).  All T tokens' K/V are
+                # written first, then each query attends with its own
+                # causal bound — exactly what T sequential decode steps
+                # would have seen, in one lowering.
+                pk.value = write_chunk_pages(
+                    pk.value, k, block_tables, seq_lens
+                )
+                pv.value = write_chunk_pages(
+                    pv.value, v, block_tables, seq_lens
+                )
+                out = paged_attention_chunk(
+                    q, pk.value, pv.value, block_tables, seq_lens,
+                    block_ctx=_tuned_block_ctx(
+                        self.page_count, self.page_size, n_kv, d_head,
+                        q.dtype,
+                    ),
+                )
+                return nn.DenseGeneral(
+                    self.d_model, axis=(-2, -1), dtype=self.dtype,
+                    name="out", use_bias=False,
+                )(out)
             else:
                 if q.shape[1] != 1:
                     raise ValueError(

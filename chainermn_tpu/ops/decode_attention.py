@@ -88,20 +88,37 @@ def write_prompt_pages(k_pages, new_k, block_tables, seq_lens):
     )
 
 
+def write_chunk_pages(k_pages, new_k, block_tables, start_lens):
+    """Scatter a T-token chunk's K (or V) per sequence into the pages.
+
+    ``new_k``: (B, T, Hkv, D) — token ``t`` of row ``b`` lands at position
+    ``start_lens[b] + t``.  Rows with ``start_lens[b] < 0`` (padding slots
+    in a chunk bucket) write nothing; positions beyond the table's reach
+    route to the invalid page and are dropped, so a chunk may safely
+    over-run a row's real suffix (speculative drafts, bucket padding) —
+    every such slot is beyond the row's masked context and is rewritten
+    by a later step before the mask can expose it.
+    """
+    N, page_size = k_pages.shape[0], k_pages.shape[1]
+    B, T = new_k.shape[0], new_k.shape[1]
+    pos = start_lens[:, None] + jnp.arange(T, dtype=jnp.int32)[None]
+    pos = jnp.where(start_lens[:, None] >= 0, pos, -1)
+    page, slot = _positions_to_pages(block_tables, pos, page_size, N)
+    return k_pages.at[page, slot].set(
+        new_k.astype(k_pages.dtype), mode="drop"
+    )
+
+
 def write_token_pages(k_pages, new_k, block_tables, seq_lens):
     """Scatter one decode token's K (or V) per sequence into the pages.
 
     ``new_k``: (B, 1, Hkv, D) — the token at position ``seq_lens[b]``
     (the context length *before* this token).  Rows with
     ``seq_lens[b] < 0`` (padding slots in a decode bucket) write nothing.
+    A T=1 chunk write is exactly this, so delegate — one lowering, one
+    set of numerics.
     """
-    N, page_size = k_pages.shape[0], k_pages.shape[1]
-    page, slot = _positions_to_pages(
-        block_tables, seq_lens[:, None], page_size, N
-    )
-    return k_pages.at[page, slot].set(
-        new_k.astype(k_pages.dtype), mode="drop"
-    )
+    return write_chunk_pages(k_pages, new_k, block_tables, seq_lens)
 
 
 def paged_attention_decode(
@@ -139,6 +156,37 @@ def paged_attention_decode(
             f"paged_attention_decode consumes one query per sequence, got "
             f"a length-{one} chunk"
         )
+    return paged_attention_chunk(
+        q, k_pages, v_pages, block_tables, seq_lens - 1,
+        block_ctx=block_ctx,
+    )
+
+
+def paged_attention_chunk(
+    q,
+    k_pages,
+    v_pages,
+    block_tables,
+    start_lens,
+    *,
+    block_ctx: Optional[int] = None,
+):
+    """Multi-query causal attention over paged K/V — the verify/suffix step.
+
+    ``q``: (B, T, H, D) — T queries per sequence at consecutive positions
+    ``start_lens[b] + t``; query ``t`` attends to cache positions
+    ``< start_lens[b] + t + 1`` (its own freshly-written slot included),
+    which is exactly the per-query causal bound a sequential decode would
+    see.  Rows with ``start_lens[b] < 0`` are padding: everything is
+    masked and the output row is garbage that callers never read.
+
+    ``paged_attention_decode`` is the T=1 special case and delegates
+    here, so single-token decode and multi-token verify share one
+    lowering — bit-identical numerics at T=1 by construction.
+
+    Returns (B, T, H, D) in ``q.dtype``.
+    """
+    B, T, H, D = q.shape
     N, page_size, Hkv, _ = k_pages.shape
     if H % Hkv:
         raise ValueError(f"n_kv_heads ({Hkv}) must divide n_heads ({H})")
@@ -167,7 +215,8 @@ def paged_attention_decode(
     scale = 1.0 / np.sqrt(D)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     ctx = k.shape[1]
-    mask = (jnp.arange(ctx)[None] < seq_lens[:, None])[:, None, None, :]
+    bounds = start_lens[:, None] + jnp.arange(T, dtype=jnp.int32)[None] + 1
+    mask = (jnp.arange(ctx)[None, None] < bounds[:, :, None])[:, None]
     logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
     weights = jax.nn.softmax(logits.astype(jnp.float32)).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)

@@ -8,15 +8,20 @@ exercises the repo's own model (:class:`~chainermn_tpu.models.transformer
 * :mod:`~chainermn_tpu.serving.kv_cache` — paged KV accounting:
   fixed-size pages, per-sequence block tables, alloc/free/defragment,
   conservation invariants, occupancy stats (vLLM's PagedAttention
-  memory model, host side);
+  memory model, host side), plus copy-on-write prefix sharing: a
+  token-run-keyed prefix index, per-page refcounts, and an LRU cached
+  pool that lets prompt pages outlive their sequences;
 * :mod:`~chainermn_tpu.serving.engine` — the execution engine: jitted
-  prefill and single-token decode with static padding buckets (bounded
-  recompiles), the paged-attention data plane from
-  :mod:`~chainermn_tpu.ops.decode_attention` (CPU-safe, tuned gather
-  chunks on TPU), host-side deterministic sampling;
+  prefill, single-token decode, and multi-token chunk steps with static
+  padding buckets (bounded recompiles), the paged-attention data plane
+  from :mod:`~chainermn_tpu.ops.decode_attention` (CPU-safe, tuned
+  gather chunks on TPU), host-side deterministic sampling;
+* :mod:`~chainermn_tpu.serving.spec` — n-gram prompt-lookup drafting
+  for speculative decoding (model-free, deterministic per request);
 * :mod:`~chainermn_tpu.serving.scheduler` — Orca-style iteration-level
-  continuous batching: FCFS admission with a free-page watermark, one
-  batched decode per step, preemption by eviction with recompute;
+  continuous batching: FCFS admission with a free-page watermark
+  (prefix hits discounted), one batched decode/verify per step,
+  preemption by eviction with recompute;
 * :mod:`~chainermn_tpu.serving.frontend` — bounded-queue submission
   with backpressure, per-request deadlines, streaming token callbacks;
 * :mod:`~chainermn_tpu.serving.cluster` — the multi-replica tier:
@@ -27,8 +32,9 @@ exercises the repo's own model (:class:`~chainermn_tpu.models.transformer
 The load-bearing property, pinned by ``tests/test_serving.py``: a token
 stream is bit-identical whether a request runs alone through
 :meth:`engine.InferenceEngine.generate` or shares continuous-batched
-iterations (including across preemption) — batching is a pure
-throughput decision, never a quality one.
+iterations — including across preemption, prefix-cache hits, and
+speculative accept/reject — batching, sharing, and speculation are pure
+throughput decisions, never quality ones.
 """
 
 from chainermn_tpu.serving.engine import (  # noqa: F401

@@ -97,7 +97,8 @@ def run_prefill_job(engine, job: PrefillJob,
     try:
         logits = engine.prefill(job.prompt, sid)
         first = engine.sample(logits, job.sampling, L)
-        snap = extract_sequence(engine, sid, context=list(job.prompt))
+        snap = extract_sequence(engine, sid, context=list(job.prompt),
+                                prompt_len=L)
     except ValueError as e:
         if traced:
             tr.record_span("prefill", job.trace, t0, tr.clock() - t0,

@@ -42,12 +42,18 @@ class KVSnapshot:
     ``pages[i][t // block_size]`` at slot ``t % block_size``, exactly as
     on the source device.  ``context`` optionally carries the token ids
     the pages encode (prompt + generated at extraction time), letting a
-    receiver fall back to re-prefill if restore is impossible."""
+    receiver fall back to re-prefill if restore is impossible.
+    ``prompt_len`` marks how many of those tokens are the immutable
+    prompt: pages fully inside that span are safe to publish into the
+    target pool's prefix index on restore (ownership travels with the
+    pages — the target can serve cache hits for the same prompt without
+    ever re-prefilling it)."""
 
     seq_len: int
     block_size: int
     pages: List[np.ndarray]
     context: Optional[List[int]] = None
+    prompt_len: Optional[int] = None
 
     @property
     def n_pages(self) -> int:
@@ -58,7 +64,8 @@ class KVSnapshot:
 
 
 def extract_sequence(engine, seq_id,
-                     context: Optional[List[int]] = None) -> KVSnapshot:
+                     context: Optional[List[int]] = None,
+                     prompt_len: Optional[int] = None) -> KVSnapshot:
     """Snapshot ``seq_id``'s pages out of ``engine``'s cache.  The
     sequence stays live on the source — callers free it (migration) or
     keep it (replication) afterwards as policy dictates."""
@@ -74,6 +81,7 @@ def extract_sequence(engine, seq_id,
         block_size=kv.block_size,
         pages=pages,
         context=None if context is None else list(map(int, context)),
+        prompt_len=None if prompt_len is None else int(prompt_len),
     )
 
 
@@ -117,6 +125,14 @@ def restore_sequence(engine, snap: KVSnapshot, seq_id) -> List[int]:
             for leaf, p in zip(leaves, snap.pages)
         ],
     )
+    if snap.prompt_len and snap.context:
+        # Migrated pages carry their sharing potential: publish the
+        # fully-written prompt pages into the target's prefix index.
+        # ``prompt_len`` is the producer's claim of how many leading
+        # context tokens have their K/V written (post-prefill that is
+        # the whole prompt); full pages inside it become shareable.
+        written = min(int(snap.prompt_len), snap.seq_len)
+        kv.register_prefix(seq_id, snap.context[:written])
     kv.assert_consistent()
     return table
 
@@ -135,6 +151,7 @@ def send_snapshot(plane, dest: int, snap: KVSnapshot, tag: int = 7) -> None:
         "seq_len": snap.seq_len,
         "block_size": snap.block_size,
         "context": snap.context,
+        "prompt_len": snap.prompt_len,
         "leaves": [(str(p.dtype), list(p.shape)) for p in snap.pages],
     }
     plane.send(meta, dest, tag=tag)
@@ -160,4 +177,5 @@ def recv_snapshot(plane, source: int, tag: int = 7,
         block_size=int(meta["block_size"]),
         pages=pages,
         context=meta["context"],
+        prompt_len=meta.get("prompt_len"),
     )

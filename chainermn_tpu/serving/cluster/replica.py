@@ -91,7 +91,8 @@ class Replica:
                  role: str = "both", reporter=None,
                  watermark_blocks: Optional[int] = None,
                  max_queue: int = 64,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 spec_tokens: int = 0):
         if role not in ROLES:
             raise ValueError(f"role {role!r} not in {ROLES}")
         self.replica_id = replica_id
@@ -100,6 +101,7 @@ class Replica:
         self.scheduler = ContinuousBatchingScheduler(
             engine, watermark_blocks=watermark_blocks,
             reporter=reporter, replica=replica_id,
+            spec_tokens=spec_tokens,
         )
         self.frontend = ServeFrontend(
             self.scheduler, max_queue=max_queue, clock=clock,

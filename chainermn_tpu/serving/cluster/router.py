@@ -132,12 +132,19 @@ class ReplicaRouter:
 
     # -- scoring -------------------------------------------------------
     @staticmethod
-    def score(load: ReplicaLoad) -> float:
-        """Higher is better; see the module docstring for the policy."""
+    def score(load: ReplicaLoad, prefix_frac: float = 0.0) -> float:
+        """Higher is better; see the module docstring for the policy.
+        ``prefix_frac`` is the fraction of the prompt already resident
+        in the replica's prefix cache: a hit skips that share of the
+        prefill *and* of the page cost, so it outweighs moderate load
+        differences (prefix-affinity routing — the fleet converges on
+        sending same-template traffic to the replica that is already
+        warm for it)."""
         return (
             2.0 * load.free_frac
             - load.queue_frac
             - 0.5 * load.batch_frac
+            + 1.5 * prefix_frac
         )
 
     def _admissible(self, load: ReplicaLoad, need_blocks: int,
@@ -159,22 +166,37 @@ class ReplicaRouter:
 
     def pick_decode_replica(self, prompt_len: int,
                             timeout_s: Optional[float] = None,
-                            now: Optional[float] = None
+                            now: Optional[float] = None,
+                            prompt_tokens: Optional[List[int]] = None,
                             ) -> Optional[Replica]:
         """The best admissible decode-capable replica for a prompt of
-        ``prompt_len`` tokens, or None when nothing admits it."""
+        ``prompt_len`` tokens, or None when nothing admits it.
+
+        When ``prompt_tokens`` is given, each candidate is probed for
+        prefix-cache hit potential (``kv.match_prefix`` is read-only),
+        the shared pages are discounted from the admission need, and the
+        hit fraction feeds the placement score — so duplicate-prefix
+        traffic sticks to the replica that already holds those pages.
+        """
         now = self.clock() if now is None else now
         best, best_key = None, None
         for rep in self.replicas.values():
             load = rep.load(now)
-            need = rep.engine.kv.blocks_for(prompt_len + 1)
+            hit_pages = 0
+            if prompt_tokens:
+                hit_pages = len(rep.engine.kv.match_prefix(prompt_tokens))
+            need = rep.engine.kv.blocks_for(prompt_len + 1) - hit_pages
             if not self._admissible(load, need, rep.scheduler.watermark):
                 continue
             if timeout_s is not None:
                 wait = self._est_queue_wait_s(load)
                 if wait is not None and wait > 0.5 * timeout_s:
                     continue
-            key = (self.score(load), repr(rep.replica_id))
+            prefix_frac = 0.0
+            if prompt_len > 0:
+                prefix_frac = (hit_pages * rep.engine.kv.block_size
+                               / prompt_len)
+            key = (self.score(load, prefix_frac), repr(rep.replica_id))
             if best_key is None or key > best_key:
                 best, best_key = rep, key
         return best
@@ -280,6 +302,7 @@ class ReplicaRouter:
         rep = self.pick_decode_replica(
             len(handle.prompt) + len(committed),
             timeout_s=handle._remaining_timeout(now), now=now,
+            prompt_tokens=handle.prompt,
         )
         if rep is None:
             self._handles.pop(handle.request_id, None)
@@ -359,6 +382,7 @@ class ReplicaRouter:
         rep = self.pick_decode_replica(
             len(handle.prompt) + len(handle.tokens),
             timeout_s=handle._remaining_timeout(now), now=now,
+            prompt_tokens=handle.prompt,
         )
         if rep is None:
             return False

@@ -74,9 +74,14 @@ class EngineConfig:
     n_blocks: int = 256
     max_len: int = 2048
     max_batch: int = 8
+    #: enable the prefix index / CoW sharing in the page accounting.
+    prefix_cache: bool = True
     prefill_buckets: Optional[Tuple[int, ...]] = None
     batch_buckets: Optional[Tuple[int, ...]] = None
     table_width_buckets: Optional[Tuple[int, ...]] = None
+    #: T ladder for the multi-token chunk step (speculative verify and
+    #: prefix-hit suffix prefill share one jitted program).
+    chunk_buckets: Optional[Tuple[int, ...]] = None
 
     def resolved(self) -> "EngineConfig":
         def pow2_ladder(lo, hi):
@@ -96,6 +101,8 @@ class EngineConfig:
             or pow2_ladder(1, self.max_batch),
             table_width_buckets=self.table_width_buckets
             or pow2_ladder(1, max_pages),
+            chunk_buckets=self.chunk_buckets
+            or pow2_ladder(1, self.max_len),
         )
 
 
@@ -130,7 +137,8 @@ class InferenceEngine:
         self.config = cfg
         self.params = params["params"] if "params" in params else params
         self.lm = lm
-        self.kv = PagedKVCache(cfg.n_blocks, cfg.block_size)
+        self.kv = PagedKVCache(cfg.n_blocks, cfg.block_size,
+                               prefix_cache=cfg.prefix_cache)
 
         twin = dict(
             vocab=lm.vocab, d_model=lm.d_model, n_heads=lm.n_heads,
@@ -140,6 +148,7 @@ class InferenceEngine:
         )
         self._prefill_model = TransformerLM(**twin, paged="prefill")
         self._decode_model = TransformerLM(**twin, paged="decode")
+        self._chunk_model = TransformerLM(**twin, paged="chunk")
 
         # Cache geometry without allocating a throwaway param set; zeros
         # ARE the empty pages (every table slot starts invalid, so stale
@@ -182,15 +191,42 @@ class InferenceEngine:
             )
             return logits[:, 0].astype(jnp.float32), upd["cache"]
 
+        def chunk_step(params, cache, tokens, block_tables, start_lens):
+            # T tokens per row starting at context position start_lens[b]
+            # (< 0 = padding row, writes drop, mask hides everything).
+            T = tokens.shape[1]
+            offs = (jnp.maximum(start_lens, 0)[:, None]
+                    + jnp.arange(T, dtype=jnp.int32)[None])
+            logits, upd = self._chunk_model.apply(
+                {"params": params, "cache": cache}, tokens,
+                position_offset=offs,
+                block_tables=block_tables, seq_lens=start_lens,
+                mutable=["cache"],
+            )
+            return logits.astype(jnp.float32), upd["cache"]
+
+        def cow_step(cache, old, new):
+            # Device half of a copy-on-write split: duplicate page `old`
+            # into the freshly-allocated page `new` on every cache leaf.
+            # old/new are traced scalars, so every split shares ONE
+            # compiled program.
+            return jax.tree.map(lambda l: l.at[new].set(l[old]), cache)
+
         # donate the pages: each step consumes the previous step's cache,
         # so the (large) page buffers update in place where the backend
         # supports aliasing.
         self._prefill_jit = jax.jit(prefill_step, donate_argnums=(1,))
         self._decode_jit = jax.jit(decode_step, donate_argnums=(1,))
+        self._chunk_jit = jax.jit(chunk_step, donate_argnums=(1,))
+        self._cow_jit = jax.jit(cow_step, donate_argnums=(0,))
         self._prefill_shapes: set = set()
         self._decode_shapes: set = set()
+        self._chunk_shapes: set = set()
         self._tokens_decoded = 0
         self._tokens_prefilled = 0
+        self._tokens_chunked = 0
+        self._tokens_prefix_cached = 0
+        self._cow_splits = 0
 
     # -- geometry ------------------------------------------------------
     @property
@@ -271,6 +307,98 @@ class InferenceEngine:
         self._tokens_decoded += B
         return np.asarray(logits[:B])
 
+    def chunk(self, token_rows, seq_ids, start_lens) -> np.ndarray:
+        """One multi-token step: for each row, write ``len(token_rows[i])``
+        consecutive tokens starting at context position ``start_lens[i]``
+        and return fp32 (B, T, vocab) logits — ``logits[i, t]`` predicts
+        position ``start_lens[i] + t + 1``, exactly what ``len(row)``
+        sequential :meth:`decode` calls would have produced (bit-exact:
+        the T=1 lowering is shared, and each query carries its own
+        causal bound).
+
+        This one program serves both speculative *verify* (row =
+        pending token + draft) and prefix-cache *suffix prefill* (row =
+        the un-shared prompt tail).  Rows may over-run a sequence's real
+        suffix (draft tokens, T-bucket padding): those writes land
+        beyond the masked context and are rewritten by a later step
+        before any mask exposes them.
+        """
+        B = len(token_rows)
+        if B == 0:
+            raise ValueError("empty chunk batch")
+        if B > self.config.max_batch:
+            raise ValueError(
+                f"chunk batch {B} exceeds max_batch {self.config.max_batch}"
+            )
+        Tmax = max(len(r) for r in token_rows)
+        if Tmax == 0:
+            raise ValueError("empty chunk row")
+        T = _bucket(Tmax, self.config.chunk_buckets, "chunk length")
+        Bp = _bucket(B, self.config.batch_buckets, "decode batch")
+        W = max(self.table_width(self.kv.seq_len(sid)) for sid in seq_ids)
+        tok = np.zeros((Bp, T), np.int32)
+        start = np.full((Bp,), -1, np.int32)
+        tables = np.full((Bp, W), self.kv.invalid, np.int32)
+        for i, (row, sid, s) in enumerate(
+            zip(token_rows, seq_ids, start_lens)
+        ):
+            tok[i, : len(row)] = np.asarray(row, np.int32)
+            start[i] = int(s)
+            tables[i] = self.kv.padded_table(sid, W)
+        self._chunk_shapes.add((Bp, T, W))
+        logits, self._cache = self._chunk_jit(
+            self.params, self._cache, jnp.asarray(tok),
+            jnp.asarray(tables), jnp.asarray(start),
+        )
+        self._tokens_chunked += sum(len(r) for r in token_rows)
+        return np.asarray(logits[:B])
+
+    def prefill_cached(self, token_ids, seq_id, n_cached: int) -> np.ndarray:
+        """Prefill a prompt whose first ``n_cached`` tokens are already
+        covered by shared prefix pages: only the suffix runs through the
+        chunk step (attending over the cached pages).  Returns the fp32
+        (vocab,) logits of the last prompt token — bit-identical to what
+        a full :meth:`prefill` would have produced.  ``n_cached`` must
+        leave at least one suffix token (the fully-cached case needs the
+        rewind path: CoW the last page, re-decode the final token)."""
+        toks = np.asarray(token_ids, np.int32).reshape(-1)
+        L = len(toks)
+        if n_cached <= 0:
+            return self.prefill(toks, seq_id)
+        if n_cached >= L:
+            raise ValueError(
+                f"n_cached {n_cached} leaves no suffix for a prompt of "
+                f"{L} tokens (use the CoW rewind path)"
+            )
+        if L >= self.config.max_len:
+            raise ValueError(
+                f"prompt of {L} tokens leaves no room to generate within "
+                f"max_len {self.config.max_len}"
+            )
+        suffix = [int(t) for t in toks[n_cached:]]
+        logits = self.chunk([suffix], [seq_id], [n_cached])
+        self._tokens_prefilled += len(suffix)
+        self._tokens_prefix_cached += n_cached
+        return logits[0, len(suffix) - 1]
+
+    def make_writable(self, seq_id, position: int) -> bool:
+        """Copy-on-write guard before a K/V write at ``position``:
+        delegates the accounting to :meth:`PagedKVCache.make_writable`
+        and, when a split happened, copies the device page so the
+        writer's fresh page starts as an exact replica.  Returns whether
+        a split happened.  May raise
+        :class:`~chainermn_tpu.serving.kv_cache.OutOfBlocks`."""
+        split = self.kv.make_writable(seq_id, position)
+        if split is None:
+            return False
+        old, new = split
+        self._cache = self._cow_jit(
+            self._cache, jnp.asarray(old, jnp.int32),
+            jnp.asarray(new, jnp.int32),
+        )
+        self._cow_splits += 1
+        return True
+
     # -- sampling ------------------------------------------------------
     @staticmethod
     def sample(logits: np.ndarray, params: SamplingParams,
@@ -311,10 +439,11 @@ class InferenceEngine:
         return int(self.kv._last_defrag_moves)
 
     def reset(self) -> None:
-        """Drop every sequence and zero the accounting (device pages are
+        """Drop every sequence and the prefix index (device pages are
         left as-is — unreachable without a table entry)."""
         for sid in self.kv.seq_ids():
             self.kv.free(sid)
+        self.kv.drop_prefix_cache()
 
     # -- introspection -------------------------------------------------
     def stats(self) -> dict:
@@ -324,14 +453,20 @@ class InferenceEngine:
             "cache": self.kv.stats().as_dict(),
             "prefill_compiles": len(self._prefill_shapes),
             "decode_compiles": len(self._decode_shapes),
+            "chunk_compiles": len(self._chunk_shapes),
             "prefill_shapes": sorted(self._prefill_shapes),
             "decode_shapes": sorted(self._decode_shapes),
+            "chunk_shapes": sorted(self._chunk_shapes),
             "tokens_prefilled": self._tokens_prefilled,
             "tokens_decoded": self._tokens_decoded,
+            "tokens_chunked": self._tokens_chunked,
+            "tokens_prefix_cached": self._tokens_prefix_cached,
+            "cow_splits": self._cow_splits,
         }
         # Cross-check against jit's own cache where the API exists.
         for name, fn in (("prefill", self._prefill_jit),
-                         ("decode", self._decode_jit)):
+                         ("decode", self._decode_jit),
+                         ("chunk", self._chunk_jit)):
             try:
                 out[f"{name}_jit_cache_size"] = fn._cache_size()
             except Exception:

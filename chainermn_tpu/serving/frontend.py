@@ -164,6 +164,7 @@ class ServeFrontend:
                on_token: Optional[Callable[[int, int], None]] = None,
                committed: Optional[List[int]] = None,
                trace=None,
+               speculative: bool = True,
                ) -> RequestHandle:
         """Enqueue one request; raises :class:`QueueFull` (with a
         ``retry_after_s`` hint once throughput is known) when the
@@ -195,6 +196,7 @@ class ServeFrontend:
             sampling=sampling or SamplingParams(),
             stop_token=stop_token,
             on_token=on_token,
+            speculative=speculative,
         )
         if committed:
             req.generated = list(map(int, committed))

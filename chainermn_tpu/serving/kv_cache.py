@@ -1,4 +1,4 @@
-"""Paged KV cache accounting — block tables, alloc/free, defragmentation.
+"""Paged KV cache accounting — block tables, alloc/free, prefix sharing.
 
 The device-side pages (the ``(n_blocks, block_size, n_kv, d_head)``
 arrays each attention layer reads and writes) live in the serving
@@ -12,26 +12,39 @@ deterministic Python:
 * one block table per live sequence: the ordered page ids covering token
   positions ``[0, seq_len)``, position ``t`` living in
   ``table[t // block_size]`` at slot ``t % block_size``;
+* a **prefix index** (vLLM/SGLang RadixAttention direction): full pages
+  whose token-id run is known are registered under the cumulative token
+  prefix they cover, so a later request with the same prompt prefix
+  *shares* those pages instead of re-prefilling them.  Shared pages
+  carry a refcount (number of referencing block tables); a page whose
+  refcount drops to zero but is still registered parks in a ``cached``
+  LRU pool — reclaimable, but resurrectable by the next prefix hit;
+* copy-on-write: before any write into a shared or registered page the
+  caller asks :meth:`make_writable`, which splits the page (fresh copy
+  for the writer, original stays in the index for everyone else);
 * conservation invariants checked on every mutation in
-  :meth:`assert_consistent` — the "leak" the tests pin is a page that is
-  neither free nor reachable from a table.
+  :meth:`assert_consistent` — every page is exactly one of free, cached,
+  or referenced by ≥1 table with a matching refcount.
 
-Eviction is *recomputable* preemption: :meth:`free` returns the pages to
-the pool and the scheduler re-prefixes the sequence (prompt + generated
-so far) through prefill when it is re-admitted — no swap-out copy, the
-standard recompute-beats-copy trade at small sequence lengths.
+Eviction is *recomputable* preemption: :meth:`free` detaches the pages
+(shared ones simply drop a reference) and the scheduler re-prefills the
+sequence when it is re-admitted — no swap-out copy, the standard
+recompute-beats-copy trade at small sequence lengths; re-admission then
+re-hits the prefix index, so a preempted sequence usually re-prefills
+only its un-shared suffix.
 
-:meth:`defragment` compacts live pages to the lowest indices (rewriting
-every table) and returns the permutation the engine applies to the
-device pages — after an eviction-heavy burst the live pages are
-scattered, and compaction restores the dense-prefix layout that keeps
-page gathers within a warm slab.
+:meth:`defragment` compacts live pages (tabled *and* cached — cached
+pages are live content, they are the prefix cache) to the lowest
+indices, rewriting every referencing table — a shared page moves once
+and every table sees the move — and returns the permutation the engine
+applies to the device pages.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -39,18 +52,24 @@ from chainermn_tpu.ops.decode_attention import invalid_block
 
 
 class OutOfBlocks(RuntimeError):
-    """Raised when an allocation cannot be satisfied from the free list.
-    The scheduler catches this and preempts (evicts) a victim sequence."""
+    """Raised when an allocation cannot be satisfied from the free list
+    plus the reclaimable cached pool.  The scheduler catches this and
+    preempts (evicts) a victim sequence."""
 
 
 @dataclasses.dataclass(frozen=True)
 class CacheStats:
-    """Occupancy snapshot — the numbers the Reporter gauges publish."""
+    """Occupancy snapshot — the numbers the Reporter gauges publish.
+
+    ``free_blocks`` counts *reclaimable* capacity: truly-free pages plus
+    cached (refcount-0 prefix) pages, which any allocation may evict.
+    ``cached_blocks`` breaks out the prefix-cache share of that."""
 
     n_blocks: int
     block_size: int
     used_blocks: int
     free_blocks: int
+    cached_blocks: int
     n_seqs: int
     utilization: float  # used / total, in [0, 1]
 
@@ -63,13 +82,20 @@ class PagedKVCache:
 
     ``n_blocks`` pages of ``block_size`` tokens each.  Sequence ids are
     caller-chosen hashables (the scheduler uses request ids).
+
+    ``prefix_cache=False`` disables the prefix index entirely:
+    :meth:`match_prefix` returns nothing and :meth:`register_prefix` is
+    a no-op, which reduces every code path below to the pre-sharing
+    behaviour (all refcounts 1, cached pool empty).
     """
 
-    def __init__(self, n_blocks: int, block_size: int):
+    def __init__(self, n_blocks: int, block_size: int, *,
+                 prefix_cache: bool = True):
         if n_blocks <= 0 or block_size <= 0:
             raise ValueError("n_blocks and block_size must be positive")
         self.n_blocks = int(n_blocks)
         self.block_size = int(block_size)
+        self.prefix_cache = bool(prefix_cache)
         #: the scatter/gather sentinel for unallocated table slots.
         self.invalid = invalid_block(self.n_blocks)
         # LIFO free list, seeded high-to-low so the first allocations
@@ -78,34 +104,176 @@ class PagedKVCache:
         self._free: List[int] = list(range(self.n_blocks - 1, -1, -1))
         self._tables: Dict[object, List[int]] = {}
         self._lens: Dict[object, int] = {}
+        #: per-page reference count — one entry per page held by ≥1 table.
+        self._ref: Dict[int, int] = {}
+        # Prefix index: cumulative token prefix (full pages only) → the
+        # page holding its LAST block, plus the reverse map.  Registered
+        # pages with refcount 0 park in the LRU ``_cached`` pool
+        # (front = oldest = first evicted).
+        self._index: Dict[Tuple[int, ...], int] = {}
+        self._index_key_of: Dict[int, Tuple[int, ...]] = {}
+        self._cached: "OrderedDict[int, None]" = OrderedDict()
         #: page moves performed by the most recent :meth:`defragment`.
         self._last_defrag_moves = 0
+        #: (old, new) CoW splits performed by the most recent
+        #: :meth:`make_writable` (the engine copies the device page).
+        self._last_cow_split: Optional[Tuple[int, int]] = None
 
     # -- sizing --------------------------------------------------------
     def blocks_for(self, n_tokens: int) -> int:
         """Pages needed to hold ``n_tokens`` positions."""
         return -(-max(0, int(n_tokens)) // self.block_size)
 
-    def can_allocate(self, n_tokens: int, reserve: int = 0) -> bool:
+    def _reclaimable(self) -> int:
+        return len(self._free) + len(self._cached)
+
+    def can_allocate(self, n_tokens: int, reserve: int = 0,
+                     prefix_pages: Optional[Sequence[int]] = None) -> bool:
         """Whether a fresh ``n_tokens``-token sequence fits, keeping
         ``reserve`` pages untouched (the scheduler's admission watermark:
         admitting a prompt that leaves zero headroom just converts the
-        next decode iteration into a preemption storm)."""
-        return self.blocks_for(n_tokens) <= len(self._free) - reserve
+        next decode iteration into a preemption storm).  With
+        ``prefix_pages`` (a :meth:`match_prefix` result) only the
+        un-shared suffix consumes capacity — sharing is what makes a
+        cache-hot prompt nearly free to admit."""
+        prefix = list(prefix_pages or [])
+        need = self.blocks_for(n_tokens) - len(prefix)
+        avail = self._reclaimable() - sum(
+            1 for p in prefix if p in self._cached
+        )
+        return need <= avail - reserve
+
+    # -- prefix index --------------------------------------------------
+    def match_prefix(self, token_ids) -> List[int]:
+        """The longest run of FULL pages from the index covering a
+        prefix of ``token_ids``.  Read-only (claiming happens in
+        :meth:`allocate`); routers use it to score placement without
+        perturbing the pool.  Returns page ids in table order."""
+        if not self.prefix_cache:
+            return []
+        toks = tuple(int(t) for t in token_ids)
+        pages: List[int] = []
+        for i in range(len(toks) // self.block_size):
+            page = self._index.get(toks[: (i + 1) * self.block_size])
+            if page is None:
+                break
+            pages.append(page)
+        return pages
+
+    def register_prefix(self, seq_id, token_ids) -> int:
+        """Publish ``seq_id``'s pages covering the full-page prefix of
+        ``token_ids`` (its prompt) into the index, so later sequences
+        can share them.  Call only once the pages' K/V is actually
+        written (post-prefill).  Pages whose prefix is already indexed
+        (including pages shared *from* the index at admission) are left
+        alone.  Returns how many pages were newly registered."""
+        if not self.prefix_cache:
+            return 0
+        table = self._tables[seq_id]
+        toks = tuple(int(t) for t in token_ids)
+        new = 0
+        for i in range(len(toks) // self.block_size):
+            key = toks[: (i + 1) * self.block_size]
+            page = table[i]
+            if key in self._index or page in self._index_key_of:
+                continue
+            self._index[key] = page
+            self._index_key_of[page] = key
+            new += 1
+        return new
+
+    def drop_prefix_cache(self) -> int:
+        """Forget every index entry and return cached (refcount-0) pages
+        to the free list — the engine's :meth:`reset` hook, restoring a
+        cleanly deterministic pool.  Still-tabled registered pages just
+        lose their registration.  Returns pages returned to the free
+        list."""
+        n = len(self._cached)
+        for page in self._cached:
+            self._free.append(page)
+        self._cached.clear()
+        self._index.clear()
+        self._index_key_of.clear()
+        return n
+
+    def refcount(self, page: int) -> int:
+        """Tables currently referencing ``page`` (0 = free or cached)."""
+        return self._ref.get(int(page), 0)
+
+    def is_registered(self, page: int) -> bool:
+        return int(page) in self._index_key_of
+
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._cached)
+
+    def _unregister(self, page: int) -> None:
+        key = self._index_key_of.pop(page, None)
+        if key is not None:
+            del self._index[key]
+
+    def _release(self, page: int) -> None:
+        """Drop one reference; at zero the page parks in the cached pool
+        (if registered) or returns to the free list."""
+        self._ref[page] -= 1
+        if self._ref[page] > 0:
+            return
+        del self._ref[page]
+        if page in self._index_key_of:
+            self._cached[page] = None  # most-recently released
+        else:
+            self._free.append(page)
+
+    def _pop_page(self) -> int:
+        """A writable page: the free list first, else evict the oldest
+        cached (refcount-0 registered) page — deterministic LRU."""
+        if self._free:
+            return self._free.pop()
+        if self._cached:
+            page, _ = self._cached.popitem(last=False)
+            self._unregister(page)
+            return page
+        raise OutOfBlocks("no free or reclaimable cached pages")
 
     # -- alloc/extend/free ---------------------------------------------
-    def allocate(self, seq_id, n_tokens: int) -> List[int]:
+    def allocate(self, seq_id, n_tokens: int,
+                 prefix_pages: Optional[Sequence[int]] = None) -> List[int]:
         """Create a sequence covering ``n_tokens`` positions; returns its
-        block table (also readable via :meth:`block_table`)."""
+        block table (also readable via :meth:`block_table`).
+
+        ``prefix_pages`` — a :meth:`match_prefix` result for this
+        sequence's leading tokens — become the table's head *shared*:
+        each gains a reference (cached pages are resurrected from the
+        pool), and only the remaining suffix draws fresh pages."""
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id!r} already allocated")
-        need = self.blocks_for(n_tokens)
-        if need > len(self._free):
-            raise OutOfBlocks(
-                f"need {need} pages for {n_tokens} tokens, "
-                f"{len(self._free)} free"
+        prefix = [int(p) for p in (prefix_pages or [])]
+        need = self.blocks_for(n_tokens) - len(prefix)
+        if need < 0:
+            raise ValueError(
+                f"{len(prefix)} prefix pages exceed the "
+                f"{self.blocks_for(n_tokens)} needed for {n_tokens} tokens"
             )
-        table = [self._free.pop() for _ in range(need)]
+        for p in prefix:
+            if p not in self._index_key_of:
+                raise ValueError(f"prefix page {p} is not registered")
+        avail = self._reclaimable() - sum(
+            1 for p in prefix if p in self._cached
+        )
+        if need > avail:
+            raise OutOfBlocks(
+                f"need {need} fresh pages for {n_tokens} tokens "
+                f"({len(prefix)} shared), {avail} reclaimable"
+            )
+        # Claim the shared head first so LRU eviction can't steal it.
+        for p in prefix:
+            if p in self._cached:
+                del self._cached[p]
+            self._ref[p] = self._ref.get(p, 0) + 1
+        fresh = [self._pop_page() for _ in range(need)]
+        for p in fresh:
+            self._ref[p] = 1
+        table = prefix + fresh
         self._tables[seq_id] = table
         self._lens[seq_id] = int(n_tokens)
         return list(table)
@@ -116,22 +284,67 @@ class PagedKVCache:
         page boundary every ``block_size`` tokens)."""
         table = self._tables[seq_id]
         need = self.blocks_for(new_len) - len(table)
-        if need > len(self._free):
+        if need > self._reclaimable():
             raise OutOfBlocks(
                 f"extending {seq_id!r} to {new_len} tokens needs {need} "
-                f"pages, {len(self._free)} free"
+                f"pages, {self._reclaimable()} reclaimable"
             )
-        fresh = [self._free.pop() for _ in range(max(0, need))]
+        fresh = [self._pop_page() for _ in range(max(0, need))]
+        for p in fresh:
+            self._ref[p] = 1
         table.extend(fresh)
         self._lens[seq_id] = max(self._lens[seq_id], int(new_len))
         return fresh
 
+    def truncate(self, seq_id, new_len: int) -> int:
+        """Shrink ``seq_id``'s coverage to ``new_len`` positions,
+        releasing trailing pages (speculative verify over-extends by the
+        draft length, then gives back what the accepted run didn't
+        need).  Returns how many pages were released."""
+        table = self._tables[seq_id]
+        keep = self.blocks_for(new_len)
+        dropped = 0
+        while len(table) > keep:
+            self._release(table.pop())
+            dropped += 1
+        self._lens[seq_id] = int(new_len)
+        return dropped
+
     def free(self, seq_id) -> int:
-        """Release every page of ``seq_id``; returns how many."""
+        """Detach every page of ``seq_id`` (shared pages drop one
+        reference; sole-owner registered pages park in the cached pool);
+        returns how many pages were detached."""
         table = self._tables.pop(seq_id)
         self._lens.pop(seq_id)
-        self._free.extend(reversed(table))
+        for page in reversed(table):
+            self._release(page)
         return len(table)
+
+    # -- copy-on-write -------------------------------------------------
+    def make_writable(self, seq_id, position: int) -> Optional[Tuple[int, int]]:
+        """Guarantee the page holding ``position`` is privately owned by
+        ``seq_id`` before a K/V write lands there.
+
+        Shared (refcount > 1) or index-registered pages are split: a
+        fresh page replaces them in THIS table only, and the caller (the
+        engine) must copy the device page ``old → new``.  Returns the
+        ``(old, new)`` pair of such a split, or ``None`` when the page
+        was already private (the overwhelmingly common case — decode
+        writes land in fresh suffix pages).  May raise
+        :class:`OutOfBlocks`; the scheduler's preemption loop handles it
+        like any allocation failure."""
+        table = self._tables[seq_id]
+        idx = int(position) // self.block_size
+        old = table[idx]
+        if self._ref[old] == 1 and old not in self._index_key_of:
+            self._last_cow_split = None
+            return None
+        new = self._pop_page()
+        self._release(old)  # registered sole-owner pages park, shared drop a ref
+        table[idx] = new
+        self._ref[new] = 1
+        self._last_cow_split = (old, new)
+        return (old, new)
 
     # -- read side -----------------------------------------------------
     def __contains__(self, seq_id) -> bool:
@@ -164,11 +377,13 @@ class PagedKVCache:
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        """Reclaimable capacity: truly free plus cached prefix pages."""
+        return self._reclaimable()
 
     @property
     def used_blocks(self) -> int:
-        return self.n_blocks - len(self._free)
+        """Pages referenced by at least one live table."""
+        return self.n_blocks - self._reclaimable()
 
     def stats(self) -> CacheStats:
         return CacheStats(
@@ -176,25 +391,46 @@ class PagedKVCache:
             block_size=self.block_size,
             used_blocks=self.used_blocks,
             free_blocks=self.free_blocks,
+            cached_blocks=self.cached_blocks,
             n_seqs=len(self._tables),
             utilization=self.used_blocks / self.n_blocks,
         )
 
     # -- invariants ----------------------------------------------------
     def assert_consistent(self) -> None:
-        """Conservation check: every page is exactly once either free or
-        in exactly one table, and every table covers its sequence's
-        length.  Cheap enough for tests to call after every operation."""
-        seen = list(self._free)
+        """Conservation check: every page is exactly one of (a) free,
+        (b) cached (registered, refcount 0), or (c) referenced by ≥1
+        table with a refcount equal to its number of referencing tables;
+        every table covers its sequence's length; the prefix index maps
+        are mutually inverse and only name live (tabled or cached)
+        pages.  Cheap enough for tests to call after every operation."""
+        free = set(self._free)
+        cached = set(self._cached)
+        tabled: Dict[int, int] = {}
         for table in self._tables.values():
-            seen.extend(table)
-        if len(seen) != self.n_blocks or len(set(seen)) != len(seen) or (
-            seen and (min(seen) < 0 or max(seen) >= self.n_blocks)
+            for page in table:
+                tabled[page] = tabled.get(page, 0) + 1
+        if len(free) != len(self._free):
+            raise AssertionError("duplicate pages on the free list")
+        if free & cached or free & tabled.keys() or cached & tabled.keys():
+            raise AssertionError(
+                f"page in two states: free∩cached="
+                f"{sorted(free & cached)}, free∩tabled="
+                f"{sorted(free & tabled.keys())}, cached∩tabled="
+                f"{sorted(cached & tabled.keys())}"
+            )
+        every = free | cached | tabled.keys()
+        if len(every) != self.n_blocks or (
+            every and (min(every) < 0 or max(every) >= self.n_blocks)
         ):
             raise AssertionError(
-                f"page leak/alias: {len(self._free)} free + "
-                f"{sum(map(len, self._tables.values()))} tabled != "
-                f"{self.n_blocks} total (or duplicate/out-of-range ids)"
+                f"page leak/alias: {len(free)} free + {len(cached)} "
+                f"cached + {len(tabled)} tabled != {self.n_blocks} total "
+                f"(or out-of-range ids)"
+            )
+        if self._ref != tabled:
+            raise AssertionError(
+                f"refcount drift: tracked {self._ref} != actual {tabled}"
             )
         for seq_id, table in self._tables.items():
             if len(table) != self.blocks_for(self._lens[seq_id]):
@@ -203,11 +439,29 @@ class PagedKVCache:
                     f"length {self._lens[seq_id]} needs "
                     f"{self.blocks_for(self._lens[seq_id])}"
                 )
+        if self._index_key_of != {
+            page: key for key, page in self._index.items()
+        } or len(self._index) != len(self._index_key_of):
+            raise AssertionError("prefix index maps are not inverse")
+        for page in self._index_key_of:
+            if page in free:
+                raise AssertionError(
+                    f"registered page {page} is on the free list"
+                )
+        for page in cached:
+            if page not in self._index_key_of:
+                raise AssertionError(
+                    f"cached page {page} has no index registration"
+                )
 
     # -- defragmentation ----------------------------------------------
     def defragment(self) -> Optional[np.ndarray]:
-        """Compact live pages to indices ``[0, used_blocks)``, preserving
-        per-sequence page order, and rewrite every table in place.
+        """Compact live pages to indices ``[0, live)``, preserving
+        per-sequence page order, and rewrite every table in place — a
+        shared page moves exactly once and every referencing table (and
+        the prefix index) observes the move.  Cached prefix pages are
+        live content and compact right after the tabled region, oldest
+        first.
 
         Returns the (n_blocks,) int32 permutation ``perm`` with
         ``new_pages[i] = old_pages[perm[i]]`` — the engine applies it to
@@ -217,8 +471,14 @@ class PagedKVCache:
         order, so a defragmented cache allocates exactly like a fresh
         one."""
         live: List[int] = []
+        seen = set()
         for seq_id in sorted(self._tables, key=repr):
-            live.extend(self._tables[seq_id])
+            for page in self._tables[seq_id]:
+                if page not in seen:
+                    seen.add(page)
+                    live.append(page)
+        for page in self._cached:
+            live.append(page)
         if live == list(range(len(live))):
             # Already the dense-prefix layout; just re-seed the free list
             # so future allocations stay dense.  No device copy.
@@ -233,6 +493,14 @@ class PagedKVCache:
         perm = np.asarray(live + leftover, np.int32)
         for table in self._tables.values():
             table[:] = [new_of_old[b] for b in table]
+        self._ref = {new_of_old[p]: c for p, c in self._ref.items()}
+        self._index = {k: new_of_old[p] for k, p in self._index.items()}
+        self._index_key_of = {
+            new_of_old[p]: k for p, k in self._index_key_of.items()
+        }
+        self._cached = OrderedDict(
+            (new_of_old[p], None) for p in self._cached
+        )
         self._free = list(range(self.n_blocks - 1, len(live) - 1, -1))
         self._last_defrag_moves = moves
         return perm

@@ -30,6 +30,7 @@ from typing import Callable, Deque, Dict, List, Optional
 from chainermn_tpu.observability import tracing as _tracing
 from chainermn_tpu.serving.engine import InferenceEngine, SamplingParams
 from chainermn_tpu.serving.kv_cache import OutOfBlocks
+from chainermn_tpu.serving.spec import propose_draft
 
 
 class RequestState(Enum):
@@ -62,6 +63,11 @@ class Request:
     generated: List[int] = dataclasses.field(default_factory=list)
     preemptions: int = 0
     error: Optional[str] = None
+    #: prompt tokens served from shared prefix pages at the most recent
+    #: admission (observability; bit-exactness is unconditional).
+    prefix_hit_tokens: int = 0
+    #: per-request opt-out for speculative decoding.
+    speculative: bool = True
     #: host step index at which the first token appeared (TTFT proxy).
     first_token_step: Optional[int] = None
     #: trace context stage spans parent to (the request's ROOT — see
@@ -102,13 +108,26 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, engine: InferenceEngine,
                  watermark_blocks: Optional[int] = None,
-                 reporter=None, replica=None):
+                 reporter=None, replica=None,
+                 spec_tokens: int = 0):
         self.engine = engine
         self.watermark = (
             engine.max_batch if watermark_blocks is None
             else int(watermark_blocks)
         )
         self.reporter = reporter
+        #: draft length for speculative decoding (0 = plain one-token
+        #: decode).  Drafts come from n-gram prompt lookup on each
+        #: request's OWN context (serving/spec.py), so the emitted
+        #: stream stays independent of batch composition — speculation
+        #: changes how many engine steps a stream takes, never its
+        #: tokens.
+        self.spec_tokens = int(spec_tokens)
+        # Prefix-cache / speculation accounting (Reporter gauge sources).
+        self._prefix_lookup_tokens = 0
+        self._prefix_hit_tokens = 0
+        self._spec_rows = 0
+        self._spec_emitted = 0
         # In a multi-replica tier every scheduler publishes the same
         # gauge names; a replica id suffixes them ("serving/running/
         # replica/<id>") so tools.obs can split the fleet into
@@ -181,13 +200,24 @@ class ContinuousBatchingScheduler:
         while self.waiting and len(self.running) < self.engine.max_batch:
             req = self.waiting[0]
             ctx = len(req.context)
+            # Shared full pages covering the prompt's head are claimed
+            # instead of allocated: a cache-hot prompt only pays for its
+            # un-shared suffix (capacity-wise AND prefill-wise).
+            prefix = self.engine.kv.match_prefix(req.prompt)
             # When nothing is running the watermark is waived — a lone
             # request that fits the bare pool must make progress.
             reserve = self.watermark if self.running else 0
-            if not self.engine.kv.can_allocate(ctx + 1, reserve=reserve):
+            if not self.engine.kv.can_allocate(ctx + 1, reserve=reserve,
+                                               prefix_pages=prefix):
                 break
             self.waiting.popleft()
-            self.engine.kv.allocate(req.request_id, ctx)
+            self.engine.kv.allocate(req.request_id, ctx,
+                                    prefix_pages=prefix)
+            req.prefix_hit_tokens = (
+                len(prefix) * self.engine.kv.block_size
+            )
+            self._prefix_lookup_tokens += len(req.prompt)
+            self._prefix_hit_tokens += req.prefix_hit_tokens
             req.state = RequestState.RUNNING
             self.running.append(req)
             admitted.append(req)
@@ -260,8 +290,33 @@ class ContinuousBatchingScheduler:
                 )
                 req.trace_enq = None
             t0 = tr.clock() if traced else 0.0
+            hit = min(req.prefix_hit_tokens, len(req.context))
             try:
-                logits = self.engine.prefill(req.context, req.request_id)
+                if hit and hit == len(req.context):
+                    # Every page of the context is shared: no prefill at
+                    # all.  Recover the last token's logits with a
+                    # one-token decode re-writing position ctx-1 — that
+                    # position lives in a shared page, so the CoW split
+                    # (private replica of the page) makes the write
+                    # legal; the rewritten K/V is bit-identical because
+                    # the attended prefix is.
+                    self.engine.make_writable(req.request_id, hit - 1)
+                    logits = self.engine.decode(
+                        [req.context[-1]], [req.request_id], [hit - 1]
+                    )[0]
+                else:
+                    logits = self.engine.prefill_cached(
+                        req.context, req.request_id, hit
+                    )
+                self.engine.kv.register_prefix(req.request_id, req.prompt)
+            except OutOfBlocks:
+                # The CoW split found no free page: un-admit; the next
+                # step retries (possibly after preemption frees pages).
+                self.engine.kv.free(req.request_id)
+                self.running.remove(req)
+                req.state = RequestState.WAITING
+                self.waiting.appendleft(req)
+                continue
             except ValueError as e:  # oversized prompt and similar
                 if traced:
                     tr.record_span(
@@ -278,6 +333,7 @@ class ContinuousBatchingScheduler:
                 tr.record_span(
                     "prefill", req.trace, t0, tr.clock() - t0,
                     replica=self.replica, tokens=len(req.context),
+                    cached=hit,
                 )
             self._emit(req, tok, tr)
             emitted += 1
@@ -310,34 +366,105 @@ class ContinuousBatchingScheduler:
             traced_reqs = [] if tr is None else [
                 r for r in batch if r.trace is not None
             ]
+            # -- speculate: n-gram drafts from each request's own context.
+            # Best-effort page growth for the draft writes; a row whose
+            # draft can't get pages (or has no recurring n-gram) simply
+            # decodes plainly within the same batched step.
+            drafts: Dict[int, List[int]] = {}
+            if self.spec_tokens > 0:
+                ts0 = tr.clock() if traced_reqs else 0.0
+                for r in batch:
+                    if not r.speculative:
+                        continue
+                    room = min(
+                        r.max_new_tokens - len(r.generated) - 1,
+                        self.engine.config.max_len - len(r.context) - 1,
+                    )
+                    d = propose_draft(
+                        r.context, min(self.spec_tokens, room)
+                    )
+                    if not d:
+                        continue
+                    try:
+                        self.engine.kv.extend(
+                            r.request_id, len(r.context) + len(d)
+                        )
+                    except OutOfBlocks:
+                        continue
+                    drafts[r.request_id] = d
+                if traced_reqs:
+                    dur = tr.clock() - ts0
+                    for r in traced_reqs:
+                        tr.record_span(
+                            "speculate", r.trace, ts0, dur,
+                            replica=self.replica,
+                            draft=len(drafts.get(r.request_id, ())),
+                        )
             t0 = tr.clock() if traced_reqs else 0.0
             # context[-1] is the token sampled last step but not yet
             # written to the pages — write it at position len-1, then
-            # the returned logits predict position len.
+            # the returned logits predict position len.  With drafts the
+            # verify chunk row is [pending, d1..dk]: logits[j] predicts
+            # position len-1+j+1, bit-exact to j+1 sequential decodes as
+            # long as d1..dj matched the sampled stream.
             lens = [len(r.context) - 1 for r in batch]
-            logits = self.engine.decode(
-                [r.context[-1] for r in batch],
-                [r.request_id for r in batch],
-                lens,
-            )
-            for i, req in enumerate(batch):
-                tok = self.engine.sample(
-                    logits[i], req.sampling, lens[i] + 1
+            if drafts:
+                logits_rows = self.engine.chunk(
+                    [[r.context[-1]] + drafts.get(r.request_id, [])
+                     for r in batch],
+                    [r.request_id for r in batch],
+                    lens,
                 )
-                self._emit(req, tok, tr)
-                emitted += 1
+            else:
+                logits = self.engine.decode(
+                    [r.context[-1] for r in batch],
+                    [r.request_id for r in batch],
+                    lens,
+                )
+            accepted_by_id: Dict[int, int] = {}
+            for i, req in enumerate(batch):
+                d = drafts.get(req.request_id, [])
+                base = len(req.context)
+                accept: List[int] = []
+                for j in range(len(d) + 1):
+                    row = logits_rows[i, j] if drafts else logits[i]
+                    tok = self.engine.sample(row, req.sampling, base + j)
+                    accept.append(tok)
+                    if j < len(d) and tok != d[j]:
+                        break  # first true token the draft missed
+                    if req.stop_token is not None and tok == req.stop_token:
+                        break
+                    if (len(req.generated) + len(accept)
+                            >= req.max_new_tokens):
+                        break
+                if drafts:
+                    self._spec_rows += 1
+                    self._spec_emitted += len(accept)
+                    accepted_by_id[req.request_id] = len(accept)
+                for tok in accept:
+                    self._emit(req, tok, tr)
+                    emitted += 1
+                # Give back pages the accepted run didn't need, restoring
+                # the between-iteration invariant (coverage == context-1,
+                # the state adopt_request and migration expect).
+                self.engine.kv.truncate(
+                    req.request_id, len(req.context) - 1
+                )
                 if req._finish_if_complete():
                     self._retire(req)
             if traced_reqs:
-                # One batched decode iteration serves every traced
-                # request in it; they share the measured duration
-                # (sampling + streaming included).
+                # One batched iteration serves every traced request in
+                # it; they share the measured duration (sampling +
+                # streaming included).
                 dur = tr.clock() - t0
+                stage = "verify" if drafts else "decode"
                 for r in traced_reqs:
-                    tr.record_span(
-                        "decode", r.trace, t0, dur,
-                        replica=self.replica, batch=len(batch),
-                    )
+                    attrs = dict(replica=self.replica, batch=len(batch))
+                    if drafts:
+                        attrs["accepted"] = accepted_by_id.get(
+                            r.request_id, 0
+                        )
+                    tr.record_span(stage, r.trace, t0, dur, **attrs)
 
         if self.reporter is not None:
             st = self.engine.kv.stats()
@@ -352,6 +479,18 @@ class ContinuousBatchingScheduler:
                                 len(self.running))
             self.reporter.gauge(f"serving/waiting{sfx}",
                                 len(self.waiting))
+            self.reporter.gauge(f"serving/cached_blocks{sfx}",
+                                st.cached_blocks)
+            if self._prefix_lookup_tokens:
+                self.reporter.gauge(
+                    f"serve/prefix_hit_rate{sfx}",
+                    self._prefix_hit_tokens / self._prefix_lookup_tokens,
+                )
+            if self._spec_rows:
+                self.reporter.gauge(
+                    f"serve/spec_accept_len{sfx}",
+                    self._spec_emitted / self._spec_rows,
+                )
             if emitted:
                 self.reporter.count("serving/tokens", emitted)
         return emitted

@@ -1,0 +1,67 @@
+"""N-gram prompt-lookup drafting for speculative decoding.
+
+The draft model here is the *free* one (prompt-lookup decoding,
+arXiv:2304.04487 / vLLM's ngram speculator): natural-language and code
+generations repeat their own context heavily, so the most recent earlier
+occurrence of the context's trailing n-gram is a cheap, surprisingly
+accurate predictor of the next few tokens.  No parameters, no extra
+forward passes, and — crucially for this codebase's bit-exactness
+contract — a **pure deterministic function of the request's own
+context**: the proposal never depends on batch composition, scheduling
+order, or preemption history, so the accepted stream can't either.
+
+Acceptance is exact-match (DeepMind-style greedy speculative sampling
+specialised to our counter-based sampler): the scheduler samples token
+``i`` from the verify logits exactly as sequential decode would have,
+accepts while the draft agrees, and always emits the first disagreeing
+*sampled* token as a bonus — so every step emits between 1 and
+``len(draft) + 1`` tokens and the stream is byte-identical to the
+sequential oracle under ANY sampling params.  A bad draft costs wasted
+chunk compute, never correctness.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+
+def propose_draft(context: Sequence[int], n_draft: int, *,
+                  max_ngram: int = 3, min_ngram: int = 1) -> List[int]:
+    """Up to ``n_draft`` draft tokens continuing ``context``.
+
+    Tries the trailing n-gram from ``max_ngram`` down to ``min_ngram``;
+    the first n for which the n-gram recurs earlier in the context wins,
+    and the tokens following its MOST RECENT earlier occurrence are the
+    draft (clipped at the context end, so the draft may be shorter than
+    ``n_draft``).  Returns ``[]`` when nothing recurs — the scheduler
+    falls back to a plain decode step for that request.
+    """
+    if n_draft <= 0:
+        return []
+    ctx = [int(t) for t in context]
+    L = len(ctx)
+    for n in range(min(max_ngram, L - 1), min_ngram - 1, -1):
+        if n <= 0:
+            break
+        tail = ctx[L - n:]
+        for s in range(L - n - 1, -1, -1):
+            if ctx[s:s + n] == tail:
+                follow = ctx[s + n:s + n + n_draft]
+                if follow:
+                    return follow
+                break  # the match sits flush at the end; shorter n won't
+    return []
+
+
+def longest_accepted(drafts: Sequence[int],
+                     sampled: Sequence[int]) -> int:
+    """How many leading draft tokens the (sequentially-exact) sampled
+    tokens confirm: ``sampled[i]`` is the true token at the position
+    ``drafts[i]`` guessed, so acceptance stops at the first mismatch.
+    Pure bookkeeping, split out for direct unit testing."""
+    n = 0
+    for d, s in zip(drafts, sampled):
+        if int(d) != int(s):
+            break
+        n += 1
+    return n

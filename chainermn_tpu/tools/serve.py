@@ -211,6 +211,7 @@ def run_local(args) -> int:
         Replica(
             i, factory(), role=roles[i],
             watermark_blocks=args.watermark, max_queue=args.max_queue,
+            spec_tokens=args.spec_tokens,
         )
         for i in range(args.replicas)
     ]
@@ -381,6 +382,10 @@ def main(argv=None) -> int:
                          "prefill-role replica first (disaggregation)")
     ap.add_argument("--watermark", type=int, default=None,
                     help="free-page admission watermark per replica")
+    ap.add_argument("--spec-tokens", type=int, default=0,
+                    help="speculative draft length per decode step "
+                         "(0 disables; streams are bit-exact either "
+                         "way, --verify proves it)")
     ap.add_argument("--max-queue", type=int, default=64,
                     help="bounded frontend queue size per replica")
     ap.add_argument("--verify", action="store_true",

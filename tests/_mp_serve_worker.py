@@ -72,6 +72,13 @@ def main():
         [int(t) for t in rng.integers(0, 32, size=int(n))]
         for n in rng.integers(4, 11, size=6)
     ]
+    # Half the fleet's traffic shares a 2-page prefix: the kill lands
+    # while refcounted/index-registered pages are live in the victim's
+    # and survivor's pools, and the survivor's clean-stop
+    # assert_consistent proves no page leaked or double-freed.
+    shared = [int(t) for t in rng.integers(0, 32, size=8)]
+    prompts = [shared + p if i % 2 == 0 else p
+               for i, p in enumerate(prompts)]
     NEW = 8
 
     if pid == 0:

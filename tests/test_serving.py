@@ -145,6 +145,102 @@ def test_kv_cache_padded_table_uses_oob_sentinel():
         kv.padded_table("a", 1)
 
 
+def test_kv_prefix_share_refcounts_and_cow_split():
+    kv = PagedKVCache(n_blocks=8, block_size=4)
+    toks = list(range(10))                   # 2 full pages + 2 tokens
+    kv.allocate("a", 10)
+    assert kv.match_prefix(toks) == []       # nothing registered yet
+    assert kv.register_prefix("a", toks) == 2
+    hit = kv.match_prefix(toks)
+    assert hit == kv.block_table("a")[:2]
+    # second sequence shares the head; only the suffix draws pages
+    before = kv.free_blocks
+    kv.allocate("b", 10, prefix_pages=hit)
+    assert kv.block_table("b")[:2] == hit
+    assert before - kv.free_blocks == 1      # 1 fresh page, not 3
+    assert kv.refcount(hit[0]) == 2
+    kv.assert_consistent()
+    # first partial-page write into a shared page → CoW split
+    split = kv.make_writable("b", 4)         # position in shared page 2
+    assert split is not None
+    old, new = split
+    assert old == hit[1] and kv.block_table("b")[1] == new
+    assert kv.refcount(old) == 1 and kv.refcount(new) == 1
+    # a's table still points at the original; the index is untouched
+    assert kv.block_table("a")[1] == old
+    assert kv.match_prefix(toks) == hit
+    # private unregistered pages never split
+    assert kv.make_writable("b", 9) is None
+    kv.assert_consistent()
+
+
+def test_kv_evict_one_of_two_sharers():
+    kv = PagedKVCache(n_blocks=8, block_size=4)
+    toks = list(range(8))
+    kv.allocate("a", 8)
+    kv.register_prefix("a", toks)
+    shared = kv.match_prefix(toks)
+    kv.allocate("b", 8, prefix_pages=shared)
+    # evict (preempt/free) one sharer: pages survive with refcount 1
+    kv.free("a")
+    kv.assert_consistent()
+    assert [kv.refcount(p) for p in shared] == [1, 1]
+    assert kv.match_prefix(toks) == shared   # still shareable
+    # evict the second: refcount-0 registered pages PARK, not free
+    kv.free("b")
+    kv.assert_consistent()
+    assert kv.cached_blocks == 2
+    assert kv.match_prefix(toks) == shared
+    # resurrection from the cached pool costs nothing
+    kv.allocate("c", 8, prefix_pages=kv.match_prefix(toks))
+    assert kv.cached_blocks == 0 and kv.block_table("c") == shared
+    kv.assert_consistent()
+
+
+def test_kv_cached_pool_lru_eviction_under_pressure():
+    kv = PagedKVCache(n_blocks=4, block_size=4)
+    kv.allocate("a", 8)
+    kv.register_prefix("a", list(range(8)))
+    kv.free("a")                             # both pages parked
+    assert kv.cached_blocks == 2
+    assert kv.free_blocks == 4               # reclaimable counts cached
+    # pool pressure evicts the OLDEST cached page and unregisters it
+    kv.allocate("b", 12)                     # needs 3: 2 free + 1 cached
+    kv.assert_consistent()
+    assert kv.cached_blocks == 1
+    assert len(kv.match_prefix(list(range(8)))) <= 1
+    with pytest.raises(OutOfBlocks):
+        kv.allocate("c", 8)                  # 1 cached + 0 free < 2
+    kv.assert_consistent()
+
+
+def test_kv_defragment_while_shared():
+    kv = PagedKVCache(n_blocks=8, block_size=4)
+    toks = list(range(8))
+    kv.allocate("a", 8)
+    kv.register_prefix("a", toks)
+    kv.allocate("hole", 8)
+    kv.allocate("b", 10, prefix_pages=kv.match_prefix(toks))
+    kv.free("hole")                          # holes mid-pool
+    shared_before = kv.match_prefix(toks)
+    perm = kv.defragment()
+    kv.assert_consistent()                   # conservation incl. refcounts
+    assert perm is not None
+    # both sharers' tables moved TOGETHER and the index followed
+    shared_after = kv.match_prefix(toks)
+    assert kv.block_table("a")[:2] == shared_after
+    assert kv.block_table("b")[:2] == shared_after
+    assert [kv.refcount(p) for p in shared_after] == [2, 2]
+    # permutation semantics: new slot i holds old page perm[i]
+    assert [perm[p] for p in shared_after] == shared_before
+    # cached (refcount-0) pages survive defrag too
+    kv.free("a")
+    kv.free("b")
+    assert kv.cached_blocks == 2
+    assert kv.defragment() is None or kv.match_prefix(toks)
+    kv.assert_consistent()
+
+
 def test_kv_cache_defragment_permutation_semantics():
     kv = PagedKVCache(n_blocks=8, block_size=4)
     kv.allocate("a", 8)
@@ -347,6 +443,145 @@ def test_scheduler_publishes_gauges_and_counters(lm, lm_params):
     assert s["counters"]["serving/tokens"] == 12
 
 
+def test_prefix_and_spec_gauges_flow_to_prometheus(lm, lm_params):
+    """serve/prefix_hit_rate and serve/spec_accept_len reach the
+    Reporter once their mechanisms fire, and render through the
+    Prometheus exporter."""
+    from chainermn_tpu.observability import Reporter
+    from chainermn_tpu.tools.obs import to_prometheus
+
+    rep = Reporter()
+    engine = make_engine(lm, lm_params)
+    sched = ContinuousBatchingScheduler(engine, reporter=rep,
+                                        spec_tokens=3)
+    # repetitive prompt → the n-gram speculator proposes drafts
+    shared = [1, 2, 3, 4, 1, 2, 3, 4]        # two full pages
+    sched.add_request(Request(request_id=0, prompt=list(shared),
+                              max_new_tokens=4))
+    sched.run_to_completion()
+    # same prompt again AFTER its pages were registered → prefix hit
+    sched.add_request(Request(request_id=1,
+                              prompt=list(shared) + [5, 6],
+                              max_new_tokens=4))
+    sched.run_to_completion()
+    g = rep.summary()["gauges"]
+    assert g["serve/prefix_hit_rate"]["value"] > 0
+    assert g["serve/spec_accept_len"]["value"] >= 1.0
+    prom = to_prometheus(rep.summary())
+    assert 'name="serve/prefix_hit_rate"' in prom
+    assert 'name="serve/spec_accept_len"' in prom
+    engine.kv.assert_consistent()
+
+
+# ---------------------------------------------------------------------------
+# Prefix cache + speculative decoding: the bit-exactness contract
+# ---------------------------------------------------------------------------
+def _shared_prefix_prompts():
+    """Duplicate-prefix traffic: alternating prompts share an 8-token
+    (2 full pages) head, one prompt IS exactly the shared head (the
+    full-hit CoW-rewind path), the rest are fully random."""
+    rng = np.random.default_rng(11)
+    shared = [int(t) for t in rng.integers(0, VOCAB, size=8)]
+    out = []
+    for i in range(6):
+        tail = [int(t) for t in rng.integers(0, VOCAB, size=3 + i % 3)]
+        out.append(shared + tail if i % 2 == 0 else tail)
+    out.append(list(shared))
+    return out
+
+
+@pytest.mark.parametrize("spec", [0, 3])
+def test_prefix_cached_and_speculative_streams_bit_exact(
+        lm, lm_params, oracle, spec):
+    prompts = _shared_prefix_prompts()
+    engine = make_engine(lm, lm_params)
+    sched = ContinuousBatchingScheduler(engine, spec_tokens=spec)
+    for i, p in enumerate(prompts):
+        sched.add_request(Request(request_id=i, prompt=list(p),
+                                  max_new_tokens=10))
+    res = sched.run_to_completion()
+    for i, p in enumerate(prompts):
+        assert res[i].state.value == "finished", res[i].error
+        assert res[i].generated == oracle(p, 10), f"request {i} diverged"
+    # the mechanisms actually fired: shared pages were claimed, the
+    # full-hit prompt took the CoW rewind, speculation emitted >1/step
+    assert sched._prefix_hit_tokens > 0
+    st = engine.stats()
+    assert st["cow_splits"] >= 1
+    assert st["tokens_prefix_cached"] > 0
+    if spec:
+        assert sched._spec_rows > 0
+        assert sched._spec_emitted > sched._spec_rows  # accept_len > 1
+    engine.kv.assert_consistent()
+    assert engine.kv.used_blocks == 0                  # cached pages only
+
+
+def test_speculative_sampled_streams_bit_exact(lm, lm_params):
+    """Under temperature sampling the acceptance rate drops but the
+    streams stay byte-identical: exact-match acceptance replays the
+    counter-based RNG at the same positions sequential decode would."""
+    prompts = _shared_prefix_prompts()
+    sp = SamplingParams(temperature=0.8, top_k=8, seed=5)
+    seq = make_engine(lm, lm_params)
+    want = [seq.generate(p, 10, sampling=sp) for p in prompts]
+    engine = make_engine(lm, lm_params)
+    sched = ContinuousBatchingScheduler(engine, spec_tokens=3)
+    for i, p in enumerate(prompts):
+        sched.add_request(Request(request_id=i, prompt=list(p),
+                                  max_new_tokens=10, sampling=sp))
+    res = sched.run_to_completion()
+    for i in range(len(prompts)):
+        assert res[i].generated == want[i], f"request {i} diverged"
+    assert sched._spec_rows > 0
+    engine.kv.assert_consistent()
+
+
+def test_speculative_survives_pool_pressure_bit_exact(lm, lm_params,
+                                                      oracle):
+    """Draft page growth is best-effort: when the pool can't hold the
+    speculative over-extension the row decodes plainly that step, and
+    preemption/recompute still replays the exact stream."""
+    engine = make_engine(lm, lm_params, n_blocks=10)
+    sched = ContinuousBatchingScheduler(engine, watermark_blocks=0,
+                                        spec_tokens=3)
+    prompts = prompts_for(4, rng_seed=11)
+    for i, p in enumerate(prompts):
+        sched.add_request(Request(request_id=i, prompt=p,
+                                  max_new_tokens=6))
+    res = sched.run_to_completion()
+    for i, p in enumerate(prompts):
+        assert res[i].state.value == "finished", res[i].error
+        assert res[i].generated == oracle(p, 6)
+    engine.kv.assert_consistent()
+    assert engine.kv.used_blocks == 0
+
+
+def test_chunk_recompile_counts_pinned(lm, lm_params):
+    """The speculative verify / suffix-prefill chunk program compiles
+    once per (batch, chunk, width) bucket: a second identical workload
+    on the same engine adds ZERO compiles of any kind."""
+    prompts = _shared_prefix_prompts()
+
+    def run(engine):
+        sched = ContinuousBatchingScheduler(engine, spec_tokens=3)
+        for i, p in enumerate(prompts):
+            sched.add_request(Request(request_id=i, prompt=list(p),
+                                      max_new_tokens=8))
+        sched.run_to_completion()
+
+    engine = make_engine(lm, lm_params)
+    run(engine)
+    st1 = engine.stats()
+    assert st1["chunk_compiles"] == len(st1["chunk_shapes"])
+    engine.reset()
+    run(engine)
+    st2 = engine.stats()
+    assert (st2["prefill_compiles"], st2["decode_compiles"],
+            st2["chunk_compiles"]) == \
+        (st1["prefill_compiles"], st1["decode_compiles"],
+         st1["chunk_compiles"])
+
+
 # ---------------------------------------------------------------------------
 # Frontend: backpressure, deadlines, streaming
 # ---------------------------------------------------------------------------
@@ -533,6 +768,37 @@ def test_serving_soak_eviction_defrag_churn(lm, lm_params, oracle):
         assert h.tokens == oracle(p, 5)
     engine.kv.assert_consistent()
     assert engine.kv.used_blocks == 0
+
+
+def test_serving_soak_shared_prefix_spec_churn(lm, lm_params, oracle):
+    """Soak (auto-marked slow): duplicate-prefix traffic + speculative
+    decoding through a pool small enough to force cached-page eviction,
+    CoW splits, preemption and defrag churn at once — every stream
+    still bit-exact, no page leaked or double-freed."""
+    engine = make_engine(lm, lm_params, n_blocks=14, max_batch=3)
+    sched = ContinuousBatchingScheduler(engine, watermark_blocks=0,
+                                        spec_tokens=3)
+    fe = ServeFrontend(sched, max_queue=64)
+    rng = np.random.default_rng(29)
+    shared = [int(t) for t in rng.integers(0, VOCAB, size=8)]
+    prompts = []
+    for i, p in enumerate(prompts_for(18, rng_seed=31, lo=3, hi=9)):
+        prompts.append(shared + p if i % 2 == 0 else p)
+    handles = [fe.submit(p, 5) for p in prompts]
+    steps = 0
+    while sched.has_work:
+        fe.step()
+        steps += 1
+        if steps % 7 == 0:
+            engine.defragment()
+            engine.kv.assert_consistent()
+        assert steps < 10_000
+    for h, p in zip(handles, prompts):
+        assert h.status == "finished", h.error
+        assert h.tokens == oracle(p, 5)
+    engine.kv.assert_consistent()
+    assert engine.kv.used_blocks == 0
+    assert sched._prefix_hit_tokens > 0  # sharing really was in play
 
 
 # ---------------------------------------------------------------------------

@@ -580,8 +580,15 @@ def test_serving_cluster_soak_threaded_failover(lm, lm_params):
     submission, one replica killed mid-stream — every stream bit-exact
     vs the sequential oracle, survivor invariants intact."""
     prompts = prompts_for(10, rng_seed=21, lo=4, hi=12)
+    # half the traffic shares a 2-page prefix so the kill lands with
+    # refcounted/registered pages live in every pool
+    rng = np.random.default_rng(37)
+    shared = [int(t) for t in rng.integers(0, VOCAB, size=8)]
+    prompts = [shared + p if i % 2 == 0 else p
+               for i, p in enumerate(prompts)]
     want = oracle_streams(lm, lm_params, prompts, 8)
-    reps = [Replica(i, make_engine(lm, lm_params), max_queue=16)
+    reps = [Replica(i, make_engine(lm, lm_params), max_queue=16,
+                    spec_tokens=2)
             for i in range(3)]
     router = ReplicaRouter(
         reps, health=HeartbeatMonitor([0, 1, 2], miss_after_s=1e9),

@@ -289,7 +289,12 @@ def _synthetic_serve_records():
     tr.token(root)
     tr.record_span("decode", root, 1000.080, 0.005, replica=1, batch=2)
     tr.token(root)
-    tr.record_span("decode", root, 1000.090, 0.005, replica=1, batch=2)
+    # a speculative iteration: draft proposal + batched verify step
+    # (the verify span replaces that iteration's decode span)
+    tr.record_span("speculate", root, 1000.089, 0.001, replica=1,
+                   draft=2)
+    tr.record_span("verify", root, 1000.090, 0.005, replica=1, batch=2,
+                   accepted=1)
     tr.token(root)
     tr.end(root, status="finished", tokens=3)
     return tr.records()
@@ -341,6 +346,21 @@ def test_prometheus_trace_series_and_header_dedupe(tmp_path):
     assert any('stage="decode",replica="1"' in l for l in lines)
     assert any(l.startswith("t_trace_stage_p99_seconds") for l in lines)
     assert any(l.startswith("t_traces_total 1") for l in lines)
+
+
+def test_speculative_stages_in_trace_stats():
+    """The speculate/verify spans a speculative iteration records flow
+    through the postmortem stats (obs trace --stats) and the Prometheus
+    stage series like any other serving stage."""
+    from chainermn_tpu.tools.obs import summarize, to_prometheus
+
+    rows = _synthetic_serve_records()
+    st = stage_percentiles(rows)
+    assert st["speculate"]["count"] == 1
+    assert st["verify"]["p99_s"] == pytest.approx(0.005)
+    text = to_prometheus(summarize(rows), prefix="t")
+    assert 't_trace_spans_total{stage="speculate"} 1' in text
+    assert 't_trace_spans_total{stage="verify"} 1' in text
 
 
 # ---------------------------------------------------------------------------
