@@ -123,6 +123,39 @@ def _flagship_gauges(flagship: str, mfu, overlap_rec) -> None:
                   float(overlap_rec["overlap_fraction"]))
 
 
+def _plan_layout_report(plan_name, params):
+    """Resolve a registry sharding plan against this bench's parameter
+    tree and record the layout it assigns: per-rule leaf counts, the
+    mesh axes the plan names, and how many leaves actually shard.  The
+    flagship benches run the explicit-collective data plane, so the
+    plan is recorded alongside the numbers, not applied to the step
+    (``applied: false`` says exactly that in the JSON)."""
+    from chainermn_tpu.sharding import get_plan, tree_path_str
+
+    plan = get_plan(plan_name)
+    rules = {}
+    sharded = total = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        total += 1
+        p = tree_path_str(path)
+        shape = tuple(getattr(leaf, "shape", ()))
+        if len(shape) == 0:
+            rules["<scalar>"] = rules.get("<scalar>", 0) + 1
+            continue
+        rule = plan.match(p, shape)
+        name = rule.name if rule else "<UNMATCHED>"
+        rules[name] = rules.get(name, 0) + 1
+        if rule and any(ax is not None for ax in tuple(rule.spec)):
+            sharded += 1
+    return {
+        "axes": list(plan.axes),
+        "rules": rules,
+        "sharded_leaves": sharded,
+        "total_leaves": total,
+        "applied": False,
+    }
+
+
 def bench_resnet(comm, args):
     from chainermn_tpu.models.resnet import ResNet50
 
@@ -273,7 +306,7 @@ def bench_resnet(comm, args):
         step, params, state, batch_stats, (x, y)
     )
     _flagship_gauges("resnet", mfu, overlap_rec)
-    return {
+    result = {
         "metric": metric,
         "overlap": comm.resolve_overlap(),
         "allreduce_overlap": overlap_rec,
@@ -289,6 +322,10 @@ def bench_resnet(comm, args):
             100.0 * (ips_samples[0] - ips_samples[-1]) / ips_samples[-1], 1
         ),
     }
+    if args.plan:
+        result["plan"] = args.plan
+        result["plan_layout"] = _plan_layout_report(args.plan, params)
+    return result
 
 
 def bench_lm(comm, args):
@@ -442,6 +479,9 @@ def bench_lm(comm, args):
     }
     if autotune_rec is not None:
         result["autotune"] = autotune_rec
+    if args.plan:
+        result["plan"] = args.plan
+        result["plan_layout"] = _plan_layout_report(args.plan, params)
     return result
 
 
@@ -908,6 +948,12 @@ def main(argv=None):
                          "tune cache), then bench with the chosen configs "
                          "pinned; the chosen (block_q, block_k, chunk) "
                          "land under the LM result's \"autotune\" key")
+    ap.add_argument("--plan", default=None, metavar="NAME",
+                    help="record a registry sharding plan (dp, tp, fsdp, "
+                         "zero, dp_tp) against the benched model: the "
+                         "result JSON gains \"plan\" and \"plan_layout\" "
+                         "(per-rule leaf counts, axes, sharded/total "
+                         "leaves); absent, the output is unchanged")
     ap.add_argument("--serve", action="store_true",
                     help="decode-throughput mode: synthetic request "
                          "traffic through the serving stack (paged KV "

@@ -4,13 +4,14 @@ The static counterpart of :mod:`chainermn_tpu.observability`'s dynamic
 census: trace any step function (or take an existing jaxpr /
 ``CollectiveAudit``) and evaluate a registry of rules — collective-order
 divergence (R001), unreduced gradients (R002), narrow-dtype reductions
-(R003), bucketing regressions (R004), missing buffer donation (R005) —
-producing structured findings *before* the first step runs.
+(R003), bucketing regressions (R004), missing buffer donation (R005),
+sharding-plan coverage (R006) — producing structured findings *before*
+the first step runs.
 
 Surfaces:
 
 * library — :func:`analyze_fn` / :func:`analyze_jaxpr` /
-  :func:`assert_lint_clean`;
+  :func:`analyze_plan` / :func:`assert_lint_clean`;
 * CLI — ``python -m chainermn_tpu.tools.lint`` (``--rules``,
   ``--format json``, nonzero exit on error findings);
 * runtime hook — ``CHAINERMN_TPU_LINT=1`` lints a built train step at
@@ -31,10 +32,11 @@ from chainermn_tpu.analysis.core import (  # noqa: F401
     Rule,
     analyze_fn,
     analyze_jaxpr,
+    analyze_plan,
     assert_lint_clean,
     collective_events,
     collective_fingerprint,
     list_rules,
     register_rule,
 )
-from chainermn_tpu.analysis import rules  # noqa: F401  (registers R001–R005)
+from chainermn_tpu.analysis import rules  # noqa: F401  (registers R001–R006)

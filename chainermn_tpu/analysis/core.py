@@ -21,6 +21,9 @@ Entry points:
 * :func:`analyze_jaxpr` — run the rules over an existing (Closed)Jaxpr
   or a :class:`~chainermn_tpu.observability.hlo_audit.CollectiveAudit`
   (rules that need the full jaxpr skip gracefully).
+* :func:`analyze_plan` — lint a sharding plan against a parameter
+  pytree (coverage rule R006); no tracing at all, only tree paths and
+  shapes are read.
 * :func:`assert_lint_clean` — raise :class:`LintError` on any
   error-severity finding; the shape pytest fixtures and CI gates want.
 
@@ -248,6 +251,10 @@ class LintContext:
     dp_axes: Tuple[str, ...] = ()
     n_leaves: Optional[int] = None
     fn: Any = None
+    #: sharding plan + parameter pytree for coverage rules (R006); set
+    #: by :func:`analyze_plan`, absent on fn/jaxpr entry points.
+    plan: Any = None
+    plan_params: Any = None
     _events: Optional[List[CollectiveEvent]] = None
 
     @property
@@ -275,6 +282,8 @@ class LintContext:
             return self.get_audit() is not None
         if req == "args":
             return self.arg_leaf_avals is not None
+        if req == "plan":
+            return self.plan is not None and self.plan_params is not None
         return False
 
 
@@ -462,6 +471,17 @@ def analyze_jaxpr(jaxpr_or_audit, comm=None,
                           dp_axes=tuple(dp_axes) if dp_axes else (),
                           n_leaves=n_leaves)
         _resolve_dp_axes(ctx)
+    return _run_rules(ctx, rules, disable)
+
+
+def analyze_plan(plan, params, rules: Optional[Sequence[str]] = None,
+                 disable: Sequence[str] = ()) -> LintReport:
+    """Lint a sharding plan against a parameter pytree (rule R006:
+    unmatched leaves, spec conflicts).  ``params`` may be arrays or
+    ``jax.ShapeDtypeStruct``s — only tree paths and shapes are read.
+    Rules whose ``requires`` name jaxpr/audit/args inputs are reported
+    in ``rules_skipped``, mirroring :func:`analyze_jaxpr`."""
+    ctx = LintContext(plan=plan, plan_params=params)
     return _run_rules(ctx, rules, disable)
 
 

@@ -166,6 +166,33 @@ def fixture_r005() -> dict:
     )
 
 
+def fixture_r006() -> dict:
+    """Sharding-plan coverage: a plan with NO catch-all (the conv
+    kernel goes unmatched) whose one rule also repeats a mesh axis in
+    two spec entries — both R006 error classes fire from one target.
+    Plan targets carry ``plan``/``params`` instead of ``fn``/``audit``;
+    jaxpr rules skip via their ``requires``."""
+    from chainermn_tpu.sharding import PlanRule, ShardingPlan
+
+    plan = ShardingPlan(
+        name="broken_fixture",
+        rules=(
+            PlanRule("dense_twice", r"dense/kernel$",
+                     P("inter", "inter")),
+        ),
+        axes=("inter",),
+    )
+    params = {
+        "dense": {"kernel": _sds((32, 32)), "bias": _sds((32,))},
+        "conv": {"kernel": _sds((3, 3, 8, 16))},
+        "step": _sds(()),  # scalar: auto-replicated, never a finding
+    }
+    return dict(
+        target="r006", expect="R006", plan=plan, params=params,
+        comm=None,
+    )
+
+
 #: Seeded compiled-HLO text for the async-pair fixture: a 4-bucket
 #: overlapped backward where the TPU compiler split every bucket
 #: allreduce into an ``all-reduce-start``/``all-reduce-done`` pair that
@@ -303,6 +330,7 @@ FIXTURES: Dict[str, Callable[[], dict]] = {
     "r003": fixture_r003,
     "r004": fixture_r004,
     "r005": fixture_r005,
+    "r006": fixture_r006,
     "overlap_async_pairs": fixture_overlap_async_pairs,
     "serving_decode": fixture_serving_decode,
     "serving_verify": fixture_serving_verify,

@@ -1,4 +1,4 @@
-"""The initial lint ruleset, R001–R005.
+"""The lint ruleset, R001–R006.
 
 Each rule is a function over a :class:`~chainermn_tpu.analysis.core.
 LintContext` registered via ``register_rule``; future parallelism PRs
@@ -301,3 +301,51 @@ def check_donation(ctx: LintContext) -> List[Finding]:
             "arguments"
         ),
     )]
+
+
+@register_rule(
+    "R006", "sharding-plan-coverage",
+    "a sharding plan leaves parameter leaves unmatched or resolves a "
+    "leaf to a spec that cannot apply",
+    requires=("plan",),
+)
+def check_plan_coverage(ctx: LintContext) -> List[Finding]:
+    # Plan targets carry no jaxpr at all — the "program" under lint is
+    # the rule table itself.  validate() does the tree walk; this rule
+    # turns its two error classes into findings (shadowed rules stay
+    # advisory: resolution is still well-defined, so they surface via
+    # validate()/the shardplan CLI, not as lint errors).
+    from chainermn_tpu.sharding import validate
+
+    v = validate(ctx.plan, ctx.plan_params)
+    findings: List[Finding] = []
+    for path in v.unmatched:
+        findings.append(Finding(
+            rule="R006", severity=SEVERITY_ERROR,
+            message=(
+                f"plan {ctx.plan.name!r} has no rule matching parameter "
+                f"leaf '{path}': resolution raises and the layout is "
+                "undefined for this model"
+            ),
+            eqn_path=path,
+            fix_hint=(
+                "add a rule whose regex matches this path, or end the "
+                "plan with a terminal catch-all "
+                "PlanRule('replicate', r'.*', P())"
+            ),
+        ))
+    for c in v.conflicts:
+        findings.append(Finding(
+            rule="R006", severity=SEVERITY_ERROR,
+            message=(
+                f"plan {ctx.plan.name!r} rule {c['rule']!r} resolves "
+                f"leaf '{c['path']}' to a conflicting spec: {c['reason']}"
+            ),
+            eqn_path=c["path"],
+            fix_hint=(
+                "fix the rule's PartitionSpec (one mesh axis per entry, "
+                "no more entries than the leaf has dims, axes that "
+                "exist on the target mesh)"
+            ),
+        ))
+    return findings

@@ -10,10 +10,12 @@ def __getattr__(name):
         from chainermn_tpu.models import convnets
 
         return getattr(convnets, name)
-    if name in ("Seq2Seq",):
+    if name in ("Seq2seq", "Seq2Seq"):
         from chainermn_tpu.models import seq2seq
 
-        return getattr(seq2seq, name)
+        # The class is spelled Seq2seq; accept the CamelCase alias the
+        # lazy table historically advertised (which never resolved).
+        return seq2seq.Seq2seq
     if name in ("Transformer", "TransformerLM"):
         from chainermn_tpu.models import transformer
 

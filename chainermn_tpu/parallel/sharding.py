@@ -16,6 +16,13 @@ two-plane split:
   ring attention;
 * **GSPMD annotation** (this module) where the parallelism is a property
   of the *weights*, which is how TP is idiomatically done on TPU.
+
+Which weights get which spec now lives in the declarative plan registry
+(:mod:`chainermn_tpu.sharding`): :func:`make_gspmd_train_step` accepts a
+:class:`~chainermn_tpu.sharding.ShardingPlan` (or registry name) and
+resolves params AND optimizer moments from its one rule table;
+:func:`transformer_param_spec` remains as a shim over what is now plan
+``"tp"``.
 """
 
 from __future__ import annotations
@@ -40,7 +47,18 @@ def transformer_param_spec(params, model_axis: str = "model"):
     models).  A model with different parameter naming would silently
     replicate everything, so a spec that shards NOTHING raises — pass a
     hand-written spec tree to :func:`make_gspmd_train_step` for custom
-    naming instead."""
+    naming instead.
+
+    .. note:: **Changed contract.**  Direct use is deprecated: the same
+       rules now live in the declarative plan registry as plan ``"tp"``
+       (``chainermn_tpu.sharding.get_plan("tp")``), which additionally
+       resolves grads, optimizer moments, and the serving KV cache from
+       one table, is lintable (rule R006), and composes with the
+       autotuner's layout search.  This shim is kept for existing
+       callers and resolves leaf-for-leaf identically to the ``tp``
+       plan (pinned by ``tests/test_shardplan.py``); new code should
+       pass a :class:`~chainermn_tpu.sharding.ShardingPlan` to
+       :func:`make_gspmd_train_step` instead.  See docs/sharding.md."""
 
     def spec_for(path, leaf) -> P:
         names = [
@@ -92,10 +110,24 @@ def make_gspmd_train_step(
     model axis are inserted by XLA from the shardings — the GSPMD
     counterpart of the communicator's explicit psum.
 
+    ``param_spec`` is either a PartitionSpec pytree matching ``params``
+    (the original contract), OR a :class:`~chainermn_tpu.sharding.
+    ShardingPlan` / registry plan name (``"tp"``, ``"dp_tp"``, …).  With
+    a plan, params AND optimizer moments resolve from the one rule
+    table — no spec tree to hand-maintain — and the jit is built at the
+    first ``shard_fn`` call (the plan needs real tree paths to resolve).
+
     Returns ``(step, shard_fn)``: ``shard_fn(params, opt_state)`` places
     initial state, ``step(params, opt_state, batch) -> (params, opt_state,
     loss)``.
     """
+    from chainermn_tpu.sharding.plan import ShardingPlan
+
+    if isinstance(param_spec, str):
+        from chainermn_tpu.sharding.registry import get_plan
+
+        param_spec = get_plan(param_spec)
+    plan = param_spec if isinstance(param_spec, ShardingPlan) else None
 
     def to_sharding(spec_tree):
         return jax.tree.map(
@@ -103,8 +135,6 @@ def make_gspmd_train_step(
             spec_tree,
             is_leaf=lambda x: isinstance(x, P),
         )
-
-    param_shardings = to_sharding(param_spec)
 
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -114,15 +144,59 @@ def make_gspmd_train_step(
 
     batch_sharding = NamedSharding(mesh, P(data_axis))
 
+    if plan is not None:
+        missing = set(plan.axes) - set(mesh.axis_names)
+        if missing:
+            raise ValueError(
+                f"sharding plan {plan.name!r} shards over axes "
+                f"{sorted(missing)} the mesh lacks (mesh axes: "
+                f"{tuple(mesh.axis_names)})"
+            )
+        state = {}
+
+        def plan_shard_fn(params, opt_state):
+            param_shardings = to_sharding(plan.resolve(params))
+            moment_shardings = to_sharding(plan.resolve_moments(opt_state))
+            # out_shardings pins the step to a placement fixed point:
+            # without it GSPMD may emit outputs in a different layout
+            # than in_shardings, and feeding the donated outputs back
+            # into the next step fails the pjit sharding check.
+            state["jit"] = jax.jit(
+                step,
+                in_shardings=(param_shardings, moment_shardings,
+                              batch_sharding),
+                out_shardings=(param_shardings, moment_shardings, None),
+                donate_argnums=(0, 1),
+            )
+            return (
+                jax.device_put(params, param_shardings),
+                jax.device_put(opt_state, moment_shardings),
+            )
+
+        def plan_step(params, opt_state, batch):
+            if "jit" not in state:
+                raise RuntimeError(
+                    "plan-driven gspmd step called before shard_fn: call "
+                    "shard_fn(params, opt_state) once to resolve the "
+                    "plan and place the initial state"
+                )
+            return state["jit"](params, opt_state, batch)
+
+        return plan_step, plan_shard_fn
+
+    param_shardings = to_sharding(param_spec)
+
     # Optimizer moments (adam's mu/nu etc.) are param-shaped; shard them
     # like their parameter so TP actually divides optimizer memory.  The
     # association mechanism is the TREE PATH: optax state leaves carry
     # their parameter's path as a suffix (e.g. ('0', 'mu', *param_path)),
     # so the longest path suffix that names a same-shaped parameter wins.
-    # Shape alone is only a fallback, and only when it's unambiguous —
-    # two same-shape params with DIFFERENT shardings (a fused-QKV weight
-    # sharded on heads next to an FFN weight sharded on d_ff, say) must
-    # not first-match-wins onto each other; such a leaf stays replicated.
+    # Path is the ONLY mechanism: scalar state (adam's count) replicates,
+    # and any other leaf whose path embeds no parameter path is a hard
+    # error — the old shape-first-match fallback could silently pick a
+    # wrong layout when two same-shape params shard differently, and
+    # plans now guarantee coverage, so a miss means the spec tree is
+    # wrong, not that the leaf deserves an arbitrary placement.
 
     def _path_key(path):
         keys = []
@@ -137,9 +211,10 @@ def make_gspmd_train_step(
                 keys.append(str(entry))
         return tuple(keys)
 
+    spec_state = {}
+
     def shard_fn(params, opt_state):
         path_to_sharding = {}
-        shape_to_shardings = {}
         param_leaves = jax.tree_util.tree_flatten_with_path(params)[0]
         sharding_leaves = jax.tree.leaves(
             param_shardings,
@@ -147,7 +222,6 @@ def make_gspmd_train_step(
         )
         for (p_path, p_leaf), s_leaf in zip(param_leaves, sharding_leaves):
             path_to_sharding[_path_key(p_path)] = (p_leaf.shape, s_leaf)
-            shape_to_shardings.setdefault(p_leaf.shape, []).append(s_leaf)
         params = jax.device_put(params, param_shardings)
         replicated = NamedSharding(mesh, P())
 
@@ -160,23 +234,42 @@ def make_gspmd_train_step(
                 hit = path_to_sharding.get(key[i:])
                 if hit is not None and hit[0] == shape:
                     return jax.device_put(x, hit[1])
-            # Shape fallback for leaves whose path embeds no param path
-            # (scalar counts keep shape () and land replicated anyway) —
-            # honored only when every same-shape param agrees.
-            candidates = shape_to_shardings.get(shape, [])
-            if candidates and all(s == candidates[0] for s in candidates):
-                return jax.device_put(x, candidates[0])
-            return jax.device_put(x, replicated)
+            if not shape:  # scalar state (adam's count): replicate
+                return jax.device_put(x, replicated)
+            raise ValueError(
+                f"optimizer state leaf '{'/'.join(key)}' (shape "
+                f"{tuple(shape)}) embeds no parameter tree path from "
+                "the spec tree — cannot infer its sharding.  Resolve "
+                "optimizer state through a ShardingPlan "
+                "(plan.resolve_moments) or extend the param_spec tree "
+                "to cover the parameter this leaf belongs to."
+            )
 
         opt_state = jax.tree_util.tree_map_with_path(opt_shard, opt_state)
+        # Rebuild the jit with the now-known optimizer-state shardings
+        # pinned on BOTH sides: out_shardings makes the step a placement
+        # fixed point, so its donated outputs feed straight back in.
+        # Without the pin GSPMD may emit an output in a different layout
+        # and the next call fails the pjit sharding check.
+        opt_shardings = jax.tree.map(lambda leaf: leaf.sharding, opt_state)
+        spec_state["jit"] = jax.jit(
+            step,
+            in_shardings=(param_shardings, opt_shardings, batch_sharding),
+            out_shardings=(param_shardings, opt_shardings, None),
+            donate_argnums=(0, 1),
+        )
         return params, opt_state
 
-    jitted = jax.jit(
+    eager = jax.jit(
         step,
         in_shardings=(param_shardings, None, batch_sharding),
         donate_argnums=(0, 1),
     )
-    return jitted, shard_fn
+
+    def spec_step(params, opt_state, batch):
+        return spec_state.get("jit", eager)(params, opt_state, batch)
+
+    return spec_step, shard_fn
 
 
 # ---------------------------------------------------------------------------

@@ -127,7 +127,8 @@ class InferenceEngine:
     """
 
     def __init__(self, lm: TransformerLM, params,
-                 config: Optional[EngineConfig] = None):
+                 config: Optional[EngineConfig] = None, *,
+                 plan=None, mesh=None):
         cfg = (config or EngineConfig(max_len=lm.max_len)).resolved()
         if cfg.max_len > lm.max_len:
             raise ValueError(
@@ -227,6 +228,50 @@ class InferenceEngine:
         self._tokens_chunked = 0
         self._tokens_prefix_cached = 0
         self._cow_splits = 0
+
+        self.plan = None
+        self.mesh = None
+        if plan is not None:
+            self._apply_plan(plan, mesh)
+
+    def _apply_plan(self, plan, mesh) -> None:
+        """Tensor-parallel placement from a sharding plan: device_put
+        the params and the KV pages with the plan's resolved
+        NamedShardings (the ``tp`` table shards attention heads / FFN
+        hidden on the params and the KV-head axis of ``k_pages`` /
+        ``v_pages``).  The jitted step programs are untouched — GSPMD
+        propagates the input shardings through the same prefill /
+        decode / chunk programs, so the single-device path stays
+        byte-identical and the TP token stream is pinned bit-exact
+        against it by ``tests/test_shardplan.py``."""
+        from chainermn_tpu.sharding import ShardingPlan, get_plan
+
+        if isinstance(plan, str):
+            plan = get_plan(plan)
+        if not isinstance(plan, ShardingPlan):
+            raise TypeError(
+                f"plan must be a ShardingPlan or registry name, got "
+                f"{type(plan).__name__}"
+            )
+        if mesh is None:
+            raise ValueError(
+                f"plan {plan.name!r} needs mesh=: the plan only names "
+                "axes; the mesh supplies the devices behind them"
+            )
+        missing = set(plan.axes) - set(mesh.axis_names)
+        if missing:
+            raise ValueError(
+                f"plan {plan.name!r} shards over axes {sorted(missing)} "
+                f"the mesh lacks (mesh axes: {tuple(mesh.axis_names)})"
+            )
+        self.plan = plan
+        self.mesh = mesh
+        self.params = jax.device_put(
+            self.params, plan.shardings(mesh, self.params)
+        )
+        self._cache = jax.device_put(
+            self._cache, plan.shardings(mesh, self._cache)
+        )
 
     # -- geometry ------------------------------------------------------
     @property

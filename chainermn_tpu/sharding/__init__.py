@@ -1,0 +1,37 @@
+"""Declarative sharding-plan subsystem.
+
+One ordered ``(name, path-regex, PartitionSpec)`` rule table — a
+:class:`ShardingPlan` — resolves params, grads, and optimizer moments
+in one pass, and the same table drives the tensor-parallel serving
+engine.  See :mod:`chainermn_tpu.sharding.plan` for the resolution
+contract, :mod:`chainermn_tpu.sharding.registry` for the built-in
+``dp`` / ``tp`` / ``dp_tp`` / ``fsdp`` / ``zero`` plans, lint rule R006
+for coverage enforcement, and ``python -m chainermn_tpu.tools.shardplan``
+for the browser CLI.
+"""
+
+from chainermn_tpu.sharding.plan import (  # noqa: F401
+    PlanRule,
+    PlanValidation,
+    ShardingPlan,
+    tree_path_str,
+    validate,
+)
+from chainermn_tpu.sharding.registry import (  # noqa: F401
+    get_plan,
+    list_plans,
+    plans_for_mesh,
+    register_plan,
+)
+
+__all__ = [
+    "PlanRule",
+    "PlanValidation",
+    "ShardingPlan",
+    "tree_path_str",
+    "validate",
+    "get_plan",
+    "list_plans",
+    "plans_for_mesh",
+    "register_plan",
+]

@@ -1,0 +1,329 @@
+"""Declarative sharding plans: one ordered rule table drives params,
+grads, and optimizer moments.
+
+A :class:`ShardingPlan` is a named, ordered list of ``(name, path-regex,
+PartitionSpec)`` rules.  Resolution walks any pytree, joins each leaf's
+tree path with ``/`` (the spelling ``tools.lint`` and the census tests
+already use), and takes the FIRST rule whose regex ``re.search``-matches
+the path and whose optional rank gate matches the leaf — scalar leaves
+are auto-replicated before any rule is consulted.  Because matching is
+substring search over the joined path, the SAME table resolves:
+
+* **params** — ``layer_0/.../query/kernel``;
+* **grads** — identical tree structure, identical paths;
+* **optimizer moments** — optax state paths EMBED the parameter path
+  (``0/mu/params/layer_0/.../query/kernel``), so the query rule matches
+  the moment leaf too, and adam's scalar ``count`` auto-replicates.
+
+That one-pass property is what lets :func:`~chainermn_tpu.parallel.
+sharding.make_gspmd_train_step`, the optimizer moment placement, and the
+tensor-parallel :class:`~chainermn_tpu.serving.engine.InferenceEngine`
+all consume the same plan object instead of re-deriving layouts
+per-consumer.  Built-in plans live in
+:mod:`chainermn_tpu.sharding.registry`; coverage is lintable via
+:func:`validate` (lint rule R006) and browsable via ``python -m
+chainermn_tpu.tools.shardplan``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def tree_path_str(path) -> str:
+    """``/``-joined spelling of a ``tree_map_with_path`` key path —
+    ``DictKey``/``GetAttrKey``/``SequenceKey`` all flatten to their bare
+    name, matching the path strings the lint fixtures and
+    ``transformer_param_spec`` key on."""
+    keys = []
+    for entry in path:
+        if hasattr(entry, "key"):
+            keys.append(str(entry.key))
+        elif hasattr(entry, "name"):
+            keys.append(str(entry.name))
+        elif hasattr(entry, "idx"):
+            keys.append(str(entry.idx))
+        else:
+            keys.append(str(entry))
+    return "/".join(keys)
+
+
+def _spec_axes(spec: P):
+    """Every mesh-axis name a PartitionSpec mentions, in entry order
+    (tuple entries like ``("data", "model")`` flatten)."""
+    axes = []
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            axes.extend(str(a) for a in entry)
+        else:
+            axes.append(str(entry))
+    return axes
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanRule:
+    """One row of the table: ``pattern`` is ``re.search``-ed against the
+    ``/``-joined leaf path; ``ndim`` (when set) additionally gates on
+    the leaf's rank — the regex-table rendering of the old
+    ``transformer_param_spec`` shape conditions (a ``query`` *bias* is
+    2-D and must fall through to replication)."""
+
+    name: str
+    pattern: str
+    spec: P
+    ndim: Optional[int] = None
+
+    def matches(self, path: str, shape: Tuple[int, ...]) -> bool:
+        if self.ndim is not None and len(shape) != self.ndim:
+            return False
+        return re.search(self.pattern, path) is not None
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingPlan:
+    """An ordered rule table with a name, the mesh axes it shards over,
+    and (optionally) a separate table for optimizer moments.
+
+    ``moment_rules`` exists for ZeRO-style plans where the *parameters*
+    stay replicated but the optimizer state shards; every other plan
+    leaves it ``None`` and moments resolve through ``rules`` (their
+    paths embed the parameter path, so they land on their parameter's
+    spec automatically)."""
+
+    name: str
+    rules: Tuple[PlanRule, ...]
+    axes: Tuple[str, ...] = ()
+    description: str = ""
+    moment_rules: Optional[Tuple[PlanRule, ...]] = None
+
+    # -- matching ------------------------------------------------------
+    def match(self, path: str, shape: Tuple[int, ...],
+              rules: Optional[Tuple[PlanRule, ...]] = None
+              ) -> Optional[PlanRule]:
+        """First rule matching ``(path, shape)``, or None.  Scalars are
+        NOT special-cased here — resolvers auto-replicate them before
+        consulting the table."""
+        for rule in (self.rules if rules is None else rules):
+            if rule.matches(path, shape):
+                return rule
+        return None
+
+    def spec_for(self, path: str, shape: Tuple[int, ...],
+                 rules: Optional[Tuple[PlanRule, ...]] = None) -> P:
+        if len(shape) == 0:
+            return P()
+        rule = self.match(path, shape, rules)
+        if rule is None:
+            raise ValueError(
+                f"sharding plan {self.name!r} has no rule matching leaf "
+                f"'{path}' (shape {tuple(shape)}) — every non-scalar "
+                "leaf must match a rule (add one, or a terminal "
+                "catch-all like PlanRule('replicate', r'.*', P()))"
+            )
+        return rule.spec
+
+    # -- resolution ----------------------------------------------------
+    def resolve(self, tree):
+        """PartitionSpec pytree for ``tree`` (params or grads — or any
+        pytree whose paths the rules understand).  Scalar leaves resolve
+        to ``P()`` without consulting the table; a non-scalar leaf no
+        rule matches raises (coverage is the plan's contract — R006 and
+        :func:`validate` report it without raising)."""
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: self.spec_for(
+                tree_path_str(path), tuple(getattr(leaf, "shape", ()))
+            ),
+            tree,
+        )
+
+    def resolve_moments(self, opt_state):
+        """PartitionSpec pytree for an optax state.  Moment leaves carry
+        their parameter's path as a suffix, so the parameter rules match
+        them directly; ``moment_rules`` (ZeRO plans) overrides the table
+        used.  Scalar state (adam's ``count``) auto-replicates."""
+        rules = self.moment_rules if self.moment_rules is not None \
+            else self.rules
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: self.spec_for(
+                tree_path_str(path), tuple(getattr(leaf, "shape", ())),
+                rules,
+            ),
+            opt_state,
+        )
+
+    def shardings(self, mesh, tree):
+        """``resolve`` lifted to :class:`NamedSharding`s over ``mesh`` —
+        what ``jax.device_put`` / ``jit(in_shardings=...)`` consume."""
+        return jax.tree.map(
+            lambda s: NamedSharding(mesh, s),
+            self.resolve(tree),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def explain(self, tree) -> List[Dict[str, Any]]:
+        """Leaf-by-leaf resolution table (the ``tools.shardplan --show``
+        payload): ``[{"path", "shape", "rule", "spec"}]`` in tree
+        order.  Unmatched leaves get ``rule=None, spec=None`` instead of
+        raising, so a broken plan can still be displayed."""
+        rows = []
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            p = tree_path_str(path)
+            shape = tuple(getattr(leaf, "shape", ()))
+            if len(shape) == 0:
+                rows.append({"path": p, "shape": shape,
+                             "rule": "<scalar>", "spec": str(P())})
+                continue
+            rule = self.match(p, shape)
+            rows.append({
+                "path": p, "shape": shape,
+                "rule": rule.name if rule else None,
+                "spec": str(rule.spec) if rule else None,
+            })
+        return rows
+
+
+@dataclasses.dataclass
+class PlanValidation:
+    """Structured :func:`validate` result.  ``unmatched`` and
+    ``conflicts`` are the error classes (what lint rule R006 fires on);
+    ``shadowed`` rules are advisory — a rule every one of whose
+    candidate leaves was claimed by an earlier rule is dead weight, but
+    the resolution is still well-defined."""
+
+    plan: str
+    unmatched: List[str] = dataclasses.field(default_factory=list)
+    shadowed: List[str] = dataclasses.field(default_factory=list)
+    #: ``[{"path", "rule", "reason"}]`` — a matched rule whose spec
+    #: cannot legally apply to the leaf (rank overflow, a mesh axis used
+    #: twice, an axis missing from the mesh, indivisible dims).
+    conflicts: List[Dict[str, str]] = dataclasses.field(
+        default_factory=list)
+    n_leaves: int = 0
+    n_sharded: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.unmatched and not self.conflicts
+
+    def summary(self) -> dict:
+        return {
+            "plan": self.plan,
+            "ok": self.ok,
+            "unmatched": list(self.unmatched),
+            "shadowed": list(self.shadowed),
+            "conflicts": [dict(c) for c in self.conflicts],
+            "n_leaves": self.n_leaves,
+            "n_sharded": self.n_sharded,
+        }
+
+    def render(self) -> str:
+        lines = [f"plan {self.plan!r}: "
+                 f"{'ok' if self.ok else 'FINDINGS'} "
+                 f"({self.n_sharded}/{self.n_leaves} leaves sharded)"]
+        for p in self.unmatched:
+            lines.append(f"  unmatched leaf: {p}")
+        for c in self.conflicts:
+            lines.append(
+                f"  conflict at {c['path']} (rule {c['rule']}): "
+                f"{c['reason']}"
+            )
+        for r in self.shadowed:
+            lines.append(f"  shadowed rule: {r}")
+        return "\n".join(lines)
+
+
+def validate(plan: ShardingPlan, params, mesh=None) -> PlanValidation:
+    """Check ``plan`` against a parameter pytree (arrays OR
+    ``ShapeDtypeStruct``s — only paths and shapes are read).
+
+    Reported:
+
+    * **unmatched** — non-scalar leaves no rule matches (resolution
+      would raise);
+    * **conflicts** — a matched spec that cannot apply: more entries
+      than the leaf has dims, the same mesh axis in two entries, or —
+      when ``mesh`` is given — an axis the mesh lacks / a sharded dim
+      the axis size does not divide;
+    * **shadowed** — rules whose every candidate leaf was claimed by an
+      earlier rule (advisory: dead table rows, often a mis-ordered
+      catch-all).
+    """
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    out = PlanValidation(plan=plan.name)
+    claimed: Dict[str, set] = {r.name: set() for r in plan.rules}
+    candidates: Dict[str, set] = {r.name: set() for r in plan.rules}
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape)) \
+        if mesh is not None else None
+
+    for path, leaf in leaves:
+        p = tree_path_str(path)
+        shape = tuple(getattr(leaf, "shape", ()))
+        out.n_leaves += 1
+        if len(shape) == 0:
+            continue
+        hit = None
+        for rule in plan.rules:
+            if not rule.matches(p, shape):
+                continue
+            candidates[rule.name].add(p)
+            if hit is None:
+                hit = rule
+                claimed[rule.name].add(p)
+        if hit is None:
+            out.unmatched.append(p)
+            continue
+        spec = hit.spec
+        axes = _spec_axes(spec)
+        if axes:
+            out.n_sharded += 1
+        if len(tuple(spec)) > len(shape):
+            out.conflicts.append({
+                "path": p, "rule": hit.name,
+                "reason": f"spec {spec} has {len(tuple(spec))} entries "
+                          f"for a rank-{len(shape)} leaf",
+            })
+            continue
+        dupes = {a for a in axes if axes.count(a) > 1}
+        if dupes:
+            out.conflicts.append({
+                "path": p, "rule": hit.name,
+                "reason": f"mesh axis {sorted(dupes)} appears in more "
+                          f"than one entry of spec {spec}",
+            })
+            continue
+        if axis_sizes is not None:
+            missing = [a for a in axes if a not in axis_sizes]
+            if missing:
+                out.conflicts.append({
+                    "path": p, "rule": hit.name,
+                    "reason": f"spec {spec} names axes {missing} absent "
+                              f"from the mesh {tuple(axis_sizes)}",
+                })
+                continue
+            for dim, entry in zip(shape, tuple(spec)):
+                if entry is None:
+                    continue
+                names = entry if isinstance(entry, (tuple, list)) \
+                    else (entry,)
+                size = 1
+                for a in names:
+                    size *= axis_sizes[str(a)]
+                if size and dim % size:
+                    out.conflicts.append({
+                        "path": p, "rule": hit.name,
+                        "reason": f"dim {dim} not divisible by axis "
+                                  f"size {size} ({'×'.join(map(str, names))})",
+                    })
+                    break
+
+    for rule in plan.rules:
+        if candidates[rule.name] and not claimed[rule.name]:
+            out.shadowed.append(rule.name)
+    return out

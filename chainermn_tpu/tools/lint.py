@@ -52,7 +52,8 @@ def _split_csv(raw: Optional[str]) -> Optional[List[str]]:
 
 
 def _lint_one(target: dict, rules, disable) -> dict:
-    from chainermn_tpu.analysis import analyze_fn, analyze_jaxpr
+    from chainermn_tpu.analysis import analyze_fn, analyze_jaxpr, \
+        analyze_plan
 
     if "audit" in target:  # pre-computed census (compiled-HLO fixtures)
         report = analyze_jaxpr(
@@ -60,6 +61,12 @@ def _lint_one(target: dict, rules, disable) -> dict:
             disable=disable or (), n_leaves=target.get("n_leaves"),
         )
         default_name = "<audit>"
+    elif "plan" in target:  # sharding-plan coverage (R006 fixtures)
+        report = analyze_plan(
+            target["plan"], target["params"], rules=rules,
+            disable=disable or (),
+        )
+        default_name = "<plan>"
     else:
         report = analyze_fn(
             target["fn"], *target.get("args", ()),

@@ -1,0 +1,250 @@
+"""Sharding-plan browser / linter CLI.
+
+Front-end for :mod:`chainermn_tpu.sharding`: list the registry, print a
+model's resolved leaf→spec table for a plan, or lint plan coverage
+(rule R006) across the model zoo.
+
+Usage::
+
+    # the registry, one line per plan:
+    python -m chainermn_tpu.tools.shardplan --list
+
+    # resolved leaf→spec table (shape-only init; no weights allocated):
+    python -m chainermn_tpu.tools.shardplan --show transformer_lm tp
+
+    # R006 coverage lint over every model × every registry plan
+    # (exit nonzero on any unmatched leaf / spec conflict):
+    python -m chainermn_tpu.tools.shardplan --lint
+    python -m chainermn_tpu.tools.shardplan --lint vit mlp --plan tp
+
+    # machine-readable:
+    python -m chainermn_tpu.tools.shardplan --list --format json
+
+Model parameter trees come from ``jax.eval_shape`` over tiny configs —
+resolution only reads paths and shapes, so no model ever materializes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def _params_of(model, *args, **kwargs):
+    """Shape-only ``params`` collection of ``model.init`` (abstract
+    eval — cheap even for GoogLeNet at 224×224)."""
+    variables = jax.eval_shape(
+        lambda k: model.init(k, *args, **kwargs), jax.random.PRNGKey(0)
+    )
+    return variables["params"]
+
+
+def _build_transformer_lm():
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    lm = TransformerLM(vocab=64, d_model=32, n_heads=4, d_ff=64,
+                       n_layers=2, max_len=16, dtype=jnp.float32)
+    return _params_of(lm, jnp.ones((1, 8), jnp.int32))
+
+
+def _build_transformer():
+    from chainermn_tpu.models.transformer import Transformer
+
+    m = Transformer(vocab=64, d_model=32, n_heads=4, d_ff=64,
+                    n_enc_layers=2, n_dec_layers=2, max_len=16,
+                    dtype=jnp.float32)
+    tok = jnp.ones((1, 8), jnp.int32)
+    return _params_of(m, tok, tok)
+
+
+def _build_vit():
+    from chainermn_tpu.models.vit import ViT
+
+    m = ViT(num_classes=10, patch=4, d_model=32, n_heads=4, d_ff=64,
+            n_layers=2, dtype=jnp.float32)
+    return _params_of(m, jnp.ones((1, 16, 16, 3), jnp.float32),
+                      train=False)
+
+
+def _build_resnet18():
+    from chainermn_tpu.models.resnet import ResNet18
+
+    m = ResNet18(num_classes=10, dtype=jnp.float32)
+    return _params_of(m, jnp.ones((1, 32, 32, 3), jnp.float32),
+                      train=False)
+
+
+def _build_alexnet():
+    from chainermn_tpu.models.convnets import AlexNet
+
+    m = AlexNet(num_classes=10, dtype=jnp.float32)
+    return _params_of(m, jnp.ones((1, 224, 224, 3), jnp.float32),
+                      train=False)
+
+
+def _build_nin():
+    from chainermn_tpu.models.convnets import NiN
+
+    m = NiN(num_classes=10, dtype=jnp.float32)
+    return _params_of(m, jnp.ones((1, 224, 224, 3), jnp.float32),
+                      train=False)
+
+
+def _build_googlenet():
+    from chainermn_tpu.models.convnets import GoogLeNet
+
+    m = GoogLeNet(num_classes=10, dtype=jnp.float32)
+    return _params_of(m, jnp.ones((1, 224, 224, 3), jnp.float32),
+                      train=False)
+
+
+def _build_mlp():
+    from chainermn_tpu.models.mlp import MLP
+
+    return _params_of(MLP(n_units=32), jnp.ones((1, 64), jnp.float32))
+
+
+def _build_seq2seq():
+    from chainermn_tpu.models.seq2seq import Seq2seq
+
+    m = Seq2seq(vocab=64, d_model=32, n_layers=2)
+    tok = jnp.ones((1, 8), jnp.int32)
+    return _params_of(m, tok, tok)
+
+
+#: model name → zero-arg builder of a shape-only ``params`` tree (tiny
+#: configs; the whole zoo the R006 acceptance gate runs over).
+MODEL_BUILDERS: Dict[str, object] = {
+    "transformer_lm": _build_transformer_lm,
+    "transformer": _build_transformer,
+    "vit": _build_vit,
+    "resnet18": _build_resnet18,
+    "alexnet": _build_alexnet,
+    "nin": _build_nin,
+    "googlenet": _build_googlenet,
+    "mlp": _build_mlp,
+    "seq2seq": _build_seq2seq,
+}
+
+
+def model_params(name: str):
+    try:
+        builder = MODEL_BUILDERS[name]
+    except KeyError:
+        raise SystemExit(
+            f"unknown model {name!r}; known: {sorted(MODEL_BUILDERS)}"
+        ) from None
+    return builder()
+
+
+def _cmd_list(args) -> int:
+    from chainermn_tpu.sharding import list_plans
+
+    plans = list_plans()
+    if args.format == "json":
+        rows = [{
+            "name": p.name, "axes": list(p.axes),
+            "n_rules": len(p.rules),
+            "moment_rules": p.moment_rules is not None,
+            "description": p.description,
+            "rules": [{"name": r.name, "pattern": r.pattern,
+                       "spec": str(r.spec), "ndim": r.ndim}
+                      for r in p.rules],
+        } for p in plans]
+        print(json.dumps({"plans": rows}, indent=2))
+    else:
+        for p in plans:
+            axes = ",".join(p.axes) or "-"
+            print(f"{p.name:8s} axes={axes:12s} rules={len(p.rules)}  "
+                  f"{p.description}")
+    return 0
+
+
+def _cmd_show(args) -> int:
+    from chainermn_tpu.sharding import get_plan
+
+    model_name, plan_name = args.show
+    plan = get_plan(plan_name)
+    rows = plan.explain(model_params(model_name))
+    if args.format == "json":
+        print(json.dumps({
+            "model": model_name, "plan": plan.name,
+            "rows": [{**r, "shape": list(r["shape"])} for r in rows],
+        }, indent=2))
+    else:
+        print(f"# {model_name} × plan {plan.name!r}")
+        width = max(len(r["path"]) for r in rows) if rows else 0
+        for r in rows:
+            spec = r["spec"] if r["spec"] is not None else "<UNMATCHED>"
+            rule = r["rule"] if r["rule"] is not None else "-"
+            print(f"{r['path']:{width}s}  {str(r['shape']):16s} "
+                  f"{spec:32s} [{rule}]")
+    return 0
+
+
+def _cmd_lint(args) -> int:
+    from chainermn_tpu.analysis import analyze_plan
+    from chainermn_tpu.sharding import get_plan, list_plans
+
+    models = args.lint or sorted(MODEL_BUILDERS)
+    plans = [get_plan(args.plan)] if args.plan else list_plans()
+    results = []
+    for model_name in models:
+        params = model_params(model_name)
+        for plan in plans:
+            report = analyze_plan(plan, params)
+            results.append({
+                "target": f"{model_name}×{plan.name}",
+                "expect": None,
+                **report.summary(),
+            })
+    ok = all(r["ok"] for r in results)
+    if args.format == "json":
+        print(json.dumps({"ok": ok, "targets": results},
+                         indent=2, sort_keys=True))
+    else:
+        for r in results:
+            status = "clean" if r["ok"] else "FINDINGS"
+            print(f"{r['target']}: {status}")
+            for f in r["findings"]:
+                print(f"  {f['rule']} [{f['severity']}]: {f['message']}")
+                if f["fix_hint"]:
+                    print(f"    fix: {f['fix_hint']}")
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m chainermn_tpu.tools.shardplan",
+        description="Sharding-plan registry browser and coverage "
+                    "linter (docs/sharding.md).",
+    )
+    ap.add_argument("--list", action="store_true",
+                    help="list registered plans")
+    ap.add_argument("--show", nargs=2, metavar=("MODEL", "PLAN"),
+                    help="resolved leaf→spec table for MODEL under PLAN")
+    ap.add_argument("--lint", nargs="*", default=None, metavar="MODEL",
+                    help="R006 coverage lint (all models when no names "
+                         "given); exit nonzero on findings")
+    ap.add_argument("--plan", default=None,
+                    help="restrict --lint to one registry plan")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        return _cmd_list(args)
+    if args.show:
+        return _cmd_show(args)
+    if args.lint is not None:
+        return _cmd_lint(args)
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,8 @@ from chainermn_tpu.tuning.search_space import (  # noqa: F401
     decode_search_space,
     flash_cache_key,
     flash_search_space,
+    layout_cache_key,
+    layout_search_space,
     overlap_cache_key,
     overlap_schedule_search_space,
 )
@@ -59,11 +61,13 @@ from chainermn_tpu.tuning.autotune import (  # noqa: F401
     lookup_ce_chunk,
     lookup_decode_block_ctx,
     lookup_flash_blocks,
+    lookup_layout,
     lookup_overlap_schedule,
     tune_allreduce_bucket,
     tune_decode_attention,
     tune_flash,
     tune_fused_ce,
+    tune_layout,
     tune_lm_shapes,
     tune_overlap_schedule,
 )

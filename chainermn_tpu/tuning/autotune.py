@@ -36,6 +36,8 @@ from chainermn_tpu.tuning.search_space import (
     flash_cache_key,
     flash_default_config,
     flash_search_space,
+    layout_cache_key,
+    layout_search_space,
     overlap_cache_key,
     overlap_schedule_search_space,
 )
@@ -166,6 +168,32 @@ def lookup_decode_block_ctx(*, n_pages: int, page_size: int, n_kv: int,
     except Exception:
         return None
     return bc if bc >= 1 else None
+
+
+def lookup_layout(*, mesh, n_params: int, n_leaves: int, dtype,
+                  model: str = "transformer_lm") -> Optional[str]:
+    """Tuned registry-plan name for one (model family, scale, mesh
+    shape) — or None (miss / disabled / the cached plan no longer fits
+    the mesh).  Callers resolve the name via
+    ``chainermn_tpu.sharding.get_plan``."""
+    if not runtime_lookup_enabled():
+        return None
+    try:
+        entry = shared_cache().get(layout_cache_key(
+            device_kind(), dtype, n_params, n_leaves,
+            tuple(mesh.devices.shape), model,
+        ))
+        if not entry:
+            return None
+        name = str(entry["plan"])
+        from chainermn_tpu.sharding import get_plan
+
+        plan = get_plan(name)
+    except Exception:
+        return None
+    if not set(plan.axes) <= set(mesh.axis_names):
+        return None
+    return name
 
 
 # --------------------------------------------------------------------------
@@ -706,6 +734,129 @@ def tune_decode_attention(
          "batch": batch},
     )
     rec["kernel"] = "paged_decode"
+    return rec
+
+
+def tune_layout(
+    *,
+    mesh,
+    batch: int = 8,
+    seq: int = 64,
+    vocab: int = 256,
+    d_model: int = 64,
+    n_heads: int = 4,
+    d_ff: int = 256,
+    n_layers: int = 2,
+    dtype="bfloat16",
+    data_axis: str = "data",
+    model: str = "transformer_lm",
+    cache: Optional[TuneCache] = None,
+    n1: int = 2,
+    repeats: int = 3,
+    force: bool = False,
+    dry_run: bool = False,
+    log: Optional[Callable[[str], None]] = None,
+) -> dict:
+    """Tune the parameter LAYOUT itself: time one gspmd train step per
+    registry sharding plan valid for ``mesh`` (dp replicate vs tp vs
+    fsdp vs zero vs dp_tp — whatever validates against the model) and
+    persist the argmin plan name.  The search space is the plan
+    registry, so a plan added by user code is automatically a candidate
+    the next tuning run; ``dp`` (today's hand-picked layout) is the
+    default the winner must beat.  ``mesh`` must carry ``data_axis``
+    (the batch always shards over it)."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from chainermn_tpu.models.transformer import TransformerLM
+    from chainermn_tpu.parallel.sharding import make_gspmd_train_step
+    from chainermn_tpu.sharding import get_plan
+
+    if data_axis not in mesh.axis_names:
+        raise ValueError(
+            f"mesh has no {data_axis!r} axis (axes: "
+            f"{tuple(mesh.axis_names)}) — the layout tuner's batch "
+            "always shards over the data axis"
+        )
+    dt = jnp.bfloat16 if dtype_name(dtype) == "bfloat16" else jnp.float32
+    lm = TransformerLM(
+        vocab=vocab, d_model=d_model, n_heads=n_heads, d_ff=d_ff,
+        n_layers=n_layers, max_len=seq, dtype=dt,
+    )
+    tokens = jax.numpy.asarray(
+        np.random.RandomState(0).randint(0, vocab, (batch, seq)), "int32"
+    )
+    params = lm.init(jax.random.PRNGKey(0), tokens)["params"]
+    # Host copies: the plan-driven step donates its param/moment buffers,
+    # and device_put may alias an on-device input's buffer into the
+    # placed tree — numpy leaves guarantee every candidate starts from
+    # fresh device arrays no earlier candidate could have donated away.
+    params = jax.tree.map(np.asarray, params)
+    leaves = jax.tree_util.tree_leaves(params)
+    n_params = int(sum(leaf.size for leaf in leaves))
+
+    space = layout_search_space(mesh.axis_names, params, mesh)
+    default_cfg = {"plan": "dp"}
+    key = layout_cache_key(
+        device_kind(), dtype, n_params, len(leaves),
+        tuple(mesh.devices.shape), model,
+    )
+    if dry_run:
+        return {"kernel": "layout", "dry_run": True, "key": key,
+                "candidates": space, "default": default_cfg}
+    _require_tuning_allowed("sharding-plan layout")
+    cache = cache or shared_cache()
+    cached = cache.get(key) if not force else None
+    if cached and cached.get("plan"):
+        return {"kernel": "layout", "key": key, "cached": True,
+                "chosen": {"plan": str(cached["plan"])}}
+
+    from chainermn_tpu.utils.profiling import sync
+
+    opt = optax.adam(1e-3)
+
+    def loss_fn(p, batch_tokens):
+        logits = lm.apply({"params": p}, batch_tokens)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        tgt = jnp.roll(batch_tokens, -1, axis=1)
+        return -jnp.mean(
+            jnp.take_along_axis(logp, tgt[..., None], axis=-1)
+        )
+
+    if log:
+        log(f"layout {key}: {len(space)} candidate plan(s): "
+            f"{[c['plan'] for c in space]}")
+
+    def build(cfg):
+        plan = get_plan(cfg["plan"])
+        step, shard_fn = make_gspmd_train_step(
+            loss_fn, opt, mesh, plan, data_axis=data_axis
+        )
+        p, s = shard_fn(params, opt.init(params))
+        holder = {"p": p, "s": s}
+
+        def run(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                holder["p"], holder["s"], loss = step(
+                    holder["p"], holder["s"], tokens
+                )
+            sync(loss)
+            return time.perf_counter() - t0
+
+        return run
+
+    results = measure_candidates(build, space, n1=n1, repeats=repeats,
+                                 log=log)
+    rec = _finish(
+        key, results, default_cfg, cache,
+        {"kernel": "layout", "dtype": dtype_name(dtype), "model": model,
+         "mesh_shape": list(int(s) for s in mesh.devices.shape),
+         "mesh_axes": list(mesh.axis_names), "n_params": n_params,
+         "n_leaves": len(leaves), "batch": batch, "seq": seq},
+    )
+    rec["kernel"] = "layout"
     return rec
 
 

@@ -312,3 +312,42 @@ def overlap_cache_key(dev_kind: str, dtype, total_bytes: int,
         (("b", bucket_pow2(total_bytes)), ("l", bucket_pow2(n_leaves))),
         {"comm": str(communicator)},
     )
+
+
+def layout_search_space(mesh_axes, params=None, mesh=None) -> List[dict]:
+    """Candidate ``{"plan"}`` configs for the parameter-layout search:
+    every registry sharding plan whose axes the mesh has — and, when a
+    parameter tree (and optionally the mesh, for divisibility) is given,
+    that validates clean against it.  The ``dp`` plan (pure data
+    parallelism, everything replicated — today's hand-picked layout) is
+    pinned first as the static default, so a tuned layout can never
+    lose to shipping no plan at all."""
+    from chainermn_tpu.sharding import list_plans, validate
+
+    axes = set(mesh_axes)
+    out = [{"plan": "dp"}]
+    for plan in list_plans():
+        if plan.name == "dp" or not set(plan.axes) <= axes:
+            continue
+        if params is not None and not validate(plan, params, mesh).ok:
+            continue
+        out.append({"plan": plan.name})
+    return out
+
+
+def layout_cache_key(dev_kind: str, dtype, n_params: int, n_leaves: int,
+                     mesh_shape, model: str = "transformer_lm") -> str:
+    """Cache key for the layout search: parameter count and leaf count
+    pow2-bucketed (layout economics shift with model scale, not exact
+    width), mesh shape and model family exact — the same plan table
+    prices completely differently on a (8,) ring vs a (4, 2) torus, and
+    across model families with different shardable structure."""
+    return make_key(
+        "layout",
+        dev_kind,
+        dtype,
+        (("p", bucket_pow2(max(1, n_params))),
+         ("l", bucket_pow2(max(1, n_leaves)))),
+        {"mesh": "x".join(str(int(s)) for s in mesh_shape),
+         "model": str(model)},
+    )

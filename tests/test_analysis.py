@@ -33,12 +33,15 @@ def _flagged(report):
 
 
 def _analyze_fixture(t):
-    from chainermn_tpu.analysis import analyze_fn, analyze_jaxpr
+    from chainermn_tpu.analysis import analyze_fn, analyze_jaxpr, \
+        analyze_plan
 
     if "audit" in t:  # pre-computed census (e.g. compiled-HLO fixtures)
         return analyze_jaxpr(
             t["audit"], comm=t["comm"], n_leaves=t.get("n_leaves")
         )
+    if "plan" in t:  # sharding-plan coverage targets (R006)
+        return analyze_plan(t["plan"], t["params"])
     return analyze_fn(t["fn"], *t["args"], comm=t["comm"], **t["kwargs"])
 
 
@@ -52,7 +55,9 @@ def _fixture_report(name):
 # ----------------------------------------------------------------------
 # Seeded violations: every rule must catch its fixture
 # ----------------------------------------------------------------------
-@pytest.mark.parametrize("name", ["r001", "r002", "r003", "r004", "r005"])
+@pytest.mark.parametrize(
+    "name", ["r001", "r002", "r003", "r004", "r005", "r006"]
+)
 def test_seeded_fixture_flagged(name):
     t, report = _fixture_report(name)
     assert t["expect"] in _flagged(report), report.render()
@@ -258,7 +263,7 @@ def test_cli_list_rules_json(capsys):
     assert lint_cli.main(["--list-rules", "--format", "json"]) == 0
     data = json.loads(capsys.readouterr().out)
     assert [r["id"] for r in data["rules"]] == [
-        "R001", "R002", "R003", "R004", "R005",
+        "R001", "R002", "R003", "R004", "R005", "R006",
     ]
 
 
