@@ -322,6 +322,12 @@ def bench_resnet(comm, args):
             100.0 * (ips_samples[0] - ips_samples[-1]) / ips_samples[-1], 1
         ),
     }
+    if comm.resolve_comm_dtype() is not None:
+        # The images/sec above were measured over the quantized wire;
+        # the full A/B (baseline rerun + measured error) lives in the
+        # LM bench — here we just label the number so it is never
+        # mistaken for a full-precision-wire measurement.
+        result["comm_dtype"] = comm.resolve_comm_dtype()
     if args.plan:
         result["plan"] = args.plan
         result["plan_layout"] = _plan_layout_report(args.plan, params)
@@ -477,6 +483,51 @@ def bench_lm(comm, args):
             100.0 * (max(samples) - min(samples)) / min(samples), 1
         ),
     }
+    if comm.resolve_comm_dtype() is not None:
+        # --comm-dtype A/B: same model, same traffic, a second optimizer
+        # over a full-precision-wire communicator; the measured
+        # quantization error (max |quantized - fp32 allreduce| over the
+        # live param tree) rides along so the speedup is never quoted
+        # without its accuracy cost.  Runs only when the wire actually
+        # resolves quantized, so the default output shape is untouched.
+        from chainermn_tpu.communicators import quant as quant_mod
+
+        quant_err = quant_mod.measure_comm_quant_error(comm, params)
+        base_comm = chainermn_tpu.create_communicator(
+            "xla_ici", overlap=False if args.no_overlap else None,
+            comm_dtype="none",
+        )
+        base_opt = chainermn_tpu.create_multi_node_optimizer(
+            optax.adamw(3e-4, weight_decay=0.1), base_comm
+        )
+        base_state = base_opt.init(params)
+        base_step = base_opt.make_train_step(loss_fn, donate=True)
+        bparams = params
+        for _ in range(3):
+            bparams, base_state, loss = base_step(
+                bparams, base_state, (tokens, labels))
+        sync(loss)
+
+        def run_base(n):
+            nonlocal bparams, base_state
+            t0 = time.perf_counter()
+            for _ in range(n):
+                bparams, base_state, loss = base_step(
+                    bparams, base_state, (tokens, labels))
+            sync(loss)
+            return time.perf_counter() - t0
+
+        base_time, _ = median_slope(run_base)
+        result["comm_dtype"] = {
+            "wire": comm.resolve_comm_dtype(),
+            "step_time_ms": round(step_time * 1e3, 3),
+            "full_precision_step_time_ms": round(base_time * 1e3, 3),
+            "tokens_per_sec_per_chip": round(tok_per_chip, 1),
+            "full_precision_tokens_per_sec_per_chip": round(
+                B * S / base_time, 1),
+            "speedup": round(base_time / step_time, 3),
+            "quant_abs_err": quant_err,
+        }
     if autotune_rec is not None:
         result["autotune"] = autotune_rec
     if args.plan:
@@ -581,13 +632,20 @@ def bench_serve(comm, args):
                 best["tokens_per_sec"]
                 / max(base["tokens_per_sec"], 1e-9), 3),
         }
+    if args.kv_dtype:
+        from chainermn_tpu.communicators.quant import canonical_kv_dtype
+
+        kd = canonical_kv_dtype(args.kv_dtype)
+        if kd is not None:
+            out["kv_dtype"] = _serve_kv_ab(args, model, params, prompts,
+                                           best, kd)
     if args.serve_replicas > 1:
         out["cluster"] = bench_serve_cluster(args, model, params)
     return out
 
 
 def _serve_sweep_point(args, model, params, prompts, bs, *,
-                       spec_tokens, prefix_cache=True):
+                       spec_tokens, prefix_cache=True, kv_dtype=None):
     """One measured serving run: fresh engine at decode batch ``bs``,
     all ``prompts`` through the queue frontend, tokens/sec plus
     per-token latency percentiles and the prefix/speculation counters.
@@ -608,6 +666,7 @@ def _serve_sweep_point(args, model, params, prompts, bs, *,
         max_len=args.serve_max_len,
         max_batch=bs,
         prefix_cache=prefix_cache,
+        kv_dtype=kv_dtype,
     )
     engine = InferenceEngine(model, params, ecfg)
     sched = ContinuousBatchingScheduler(engine, spec_tokens=spec_tokens)
@@ -679,7 +738,83 @@ def _serve_sweep_point(args, model, params, prompts, bs, *,
     if sched._spec_rows:
         row["spec_accept_len"] = round(
             sched._spec_emitted / sched._spec_rows, 3)
+    if "kv_quant_err" in st:
+        row["kv_dtype"] = st["kv_dtype"]
+        row["kv_quant_err"] = st["kv_quant_err"]
     return row
+
+
+def _serve_kv_ab(args, model, params, prompts, best, kv_dtype):
+    """--kv-dtype A/B at the winning batch size: quantized pages vs the
+    full-precision run on identical traffic (tokens/s, p99, speculative
+    accept length, and the measured per-element quantization error),
+    plus the capacity point the narrow pages buy.
+
+    The capacity point is computed from the engines' REAL page byte
+    sizes, not a formula: at a fixed pool byte budget (the bytes the
+    full-precision pool occupies), how many decode sequences of this
+    workload's footprint (prompt + new tokens) fit?  int8 pages store
+    one byte per element plus one f32 amax scale per token per KV head,
+    so vs d-byte full-precision elements the ratio approaches
+    d / (1 + 4 / d_head); at the bench default geometry (d_head 128)
+    that is ~1.94x vs bf16 and ~3.9x vs fp32 pages.
+    """
+    from chainermn_tpu.serving import EngineConfig, InferenceEngine
+
+    bs = best["batch_size"]
+
+    def pool_bytes(kd):
+        eng = InferenceEngine(model, params, EngineConfig(
+            block_size=args.serve_block_size, n_blocks=args.serve_blocks,
+            max_len=args.serve_max_len, max_batch=bs, kv_dtype=kd,
+        ))
+        return sum(l.nbytes for l in jax.tree.leaves(eng._cache))
+
+    q = _serve_sweep_point(args, model, params, prompts, bs,
+                           spec_tokens=args.serve_spec_tokens,
+                           kv_dtype=kv_dtype)
+    full_bytes = pool_bytes(None)
+    quant_bytes = pool_bytes(kv_dtype)
+    # Max admissible decode batch at the full-precision pool's byte
+    # budget: every sequence pins ceil((P + N) / block_size) pages for
+    # its whole lifetime, and narrow pages mean more pages in the pool.
+    seq_tokens = args.serve_prompt_len + args.serve_new_tokens
+    pages_per_seq = -(-seq_tokens // args.serve_block_size)
+    quant_blocks = int(full_bytes * args.serve_blocks // quant_bytes)
+    batch_full = args.serve_blocks // pages_per_seq
+    batch_quant = quant_blocks // pages_per_seq
+    rec = {
+        "kv_dtype": kv_dtype,
+        "batch_size": bs,
+        "tokens_per_sec": q["tokens_per_sec"],
+        "tokens_per_sec_full_precision": best["tokens_per_sec"],
+        "p99_token_latency_ms": q["p99_token_latency_ms"],
+        "p99_full_precision_ms": best["p99_token_latency_ms"],
+        "kv_quant_err": q.get("kv_quant_err"),
+        "capacity_at_fixed_pool_bytes": {
+            "pool_bytes": full_bytes,
+            "page_bytes_full_precision": round(
+                full_bytes / args.serve_blocks, 1),
+            "page_bytes_quantized": round(
+                quant_bytes / args.serve_blocks, 1),
+            "pages_per_sequence": pages_per_seq,
+            "max_decode_batch_full_precision": batch_full,
+            "max_decode_batch_quantized": batch_quant,
+            "capacity_ratio": round(
+                batch_quant / max(batch_full, 1), 3),
+        },
+    }
+    # Speculative decoding drafts against quantized pages and verifies
+    # against them too — the accept-length delta is the knock-on cost.
+    if "spec_accept_len" in best or "spec_accept_len" in q:
+        rec["spec_accept_len"] = q.get("spec_accept_len")
+        rec["spec_accept_len_full_precision"] = best.get(
+            "spec_accept_len")
+        if (q.get("spec_accept_len") is not None
+                and best.get("spec_accept_len") is not None):
+            rec["spec_accept_len_delta"] = round(
+                q["spec_accept_len"] - best["spec_accept_len"], 3)
+    return rec
 
 
 def _bench_serve_traced(args, model, params, best, prompts):
@@ -991,6 +1126,23 @@ def main(argv=None):
                     help="speculative draft length for the serve "
                          "sweep's spec-ON column (OFF column always "
                          "runs alongside)")
+    ap.add_argument("--comm-dtype", default=None,
+                    choices=["none", "int8", "fp8"],
+                    help="quantized gradient wire for the train benches "
+                         "(scaled int8/fp8 allreduce); when set to a "
+                         "narrow dtype the LM result gains a "
+                         "\"comm_dtype\" A/B section (step time and "
+                         "tokens/s vs the full-precision wire, plus the "
+                         "measured max-abs quantization error); unset "
+                         "leaves the output shape unchanged")
+    ap.add_argument("--kv-dtype", default=None, choices=["none", "int8"],
+                    help="with --serve: also measure the int8 paged KV "
+                         "cache — the serve result gains a \"kv_dtype\" "
+                         "A/B section (tokens/s and p99 vs full-precision "
+                         "pages, kv quantization error, speculative "
+                         "accept-length delta, and the max-admissible "
+                         "decode batch at the SAME pool byte budget); "
+                         "unset leaves the output shape unchanged")
     ap.add_argument("--no-overlap", action="store_true",
                     help="pin the eager pack-all-then-reduce-all "
                          "gradient schedule (overlap=False on the "
@@ -1012,7 +1164,8 @@ def main(argv=None):
 
         overlap_mod.ensure_overlap_flags()
     comm = chainermn_tpu.create_communicator(
-        "xla_ici", overlap=False if args.no_overlap else None
+        "xla_ici", overlap=False if args.no_overlap else None,
+        comm_dtype=args.comm_dtype,
     )
 
     telemetry = contextlib.ExitStack()

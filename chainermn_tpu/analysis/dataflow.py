@@ -7,7 +7,9 @@ data-parallel axes?" — is a forward dataflow problem.  Each variable
 carries a taint: the set of data-parallel axis names whose reduction it
 still owes.  Batch inputs start tainted with every data-parallel axis;
 ``psum``/``psum_scatter`` eqns clear the axes they reduce over from
-their operands' joint taint; every other eqn propagates the union of
+their operands' joint taint (so do ``pmax``/``pmin`` — their output is
+rank-invariant over the reduced axes, e.g. the agreed amax scale of
+the quantized wire); every other eqn propagates the union of
 its inputs' taints (sound over-approximation: any output *may* depend
 on any input).  Control/structural primitives recurse into their inner
 jaxprs so the analysis sees through ``pjit``, ``shard_map``, ``scan``
@@ -30,6 +32,12 @@ from chainermn_tpu.observability.hlo_audit import (
 )
 
 EMPTY: FrozenSet[str] = frozenset()
+
+#: primitives whose output is identical on every rank of the reduced
+#: axes — taint-clearing just like psum.  pmax/pmin matter for the
+#: scaled-quantization wire: the per-bucket scale derives from this
+#: device's gradients but is amax-agreed across the world before use.
+_RANK_INVARIANT_PRIMITIVES = ("pmax", "pmin")
 
 #: param keys under which jax stores a single inner jaxpr with invars
 #: matching the eqn's 1:1 (pjit, shard_map, closed_call, custom_jvp/vjp,
@@ -95,7 +103,7 @@ def _process(eqn, read, write, max_iter: int) -> None:
     ins = [read(v) for v in eqn.invars]
     joint = _union(ins)
 
-    if name in REDUCTION_PRIMITIVES:
+    if name in REDUCTION_PRIMITIVES or name in _RANK_INVARIANT_PRIMITIVES:
         cleared = joint - _eqn_reduced_axes(eqn)
         for v in eqn.outvars:
             write(v, cleared)

@@ -135,6 +135,62 @@ def fixture_r003() -> dict:
     )
 
 
+def fixture_quant_scaled_allreduce() -> dict:
+    """The blessed scale→cast→reduce→cast→unscale wire (CLEAN,
+    ``expect=None``): ``allreduce_grad`` under ``comm_dtype="int8"``
+    traces a pmax amax exchange followed by an int8 psum.  The fixture
+    hands the linter NO communicator, so R003 must recognize the
+    pattern structurally — an amax pmax covering the reduction axes —
+    rather than lean on the comm_dtype suppression gate."""
+    comm = create_communicator("xla_ici", mesh=_mesh(), comm_dtype="int8")
+    n = comm.device_size
+
+    def reduce_quantized(tree):
+        def body(t):
+            sq = jax.tree.map(lambda x: jnp.squeeze(x, 0), t)
+            out = comm.allreduce_grad(sq)
+            return jax.tree.map(lambda x: x[None], out)
+        spec = jax.tree.map(lambda _: comm._world_spec, tree)
+        return comm.shard_map(body, in_specs=(spec,), out_specs=spec)(tree)
+
+    tree = {
+        "a": _sds((n, 256)),
+        "b": _sds((n, 64, 8)),
+    }
+    # donate like the real backward pass does: gradients are consumed
+    # by the reduction (also keeps the donation audit R005 satisfied).
+    return dict(
+        target="quant_scaled_allreduce", expect=None,
+        fn=jax.jit(reduce_quantized, donate_argnums=(0,)),
+        args=(tree,), kwargs={}, comm=None,
+    )
+
+
+def fixture_r003_bare_int8() -> dict:
+    """Bare int8 reduction (fires R003): gradients cast to int8 and
+    psum'd directly, with no amax scale exchange — the integer sum
+    wraps as soon as two ranks carry same-sign values near the rail."""
+    comm = create_communicator("naive", mesh=_mesh())
+    n = comm.device_size
+
+    def reduce_bare_int8(tree):
+        def body(t):
+            def one(x):
+                q = jnp.clip(jnp.round(jnp.squeeze(x, 0)), -127, 127)
+                s = lax.psum(q.astype(jnp.int8), comm.axes)
+                return s.astype(jnp.float32)[None]
+            return jax.tree.map(one, t)
+        spec = jax.tree.map(lambda _: comm._world_spec, tree)
+        return comm.shard_map(body, in_specs=(spec,), out_specs=spec)(tree)
+
+    tree = {"g": _sds((n, 128))}
+    return dict(
+        target="r003_bare_int8", expect="R003",
+        fn=jax.jit(reduce_bare_int8, donate_argnums=(0,)),
+        args=(tree,), kwargs={}, comm=comm,
+    )
+
+
 def fixture_r004() -> dict:
     """Bucketing regression: a default train step over a 16-leaf tree
     with bucketing disabled (bucket_bytes=0) — one psum per leaf."""
@@ -328,6 +384,8 @@ FIXTURES: Dict[str, Callable[[], dict]] = {
     "r001": fixture_r001,
     "r002": fixture_r002,
     "r003": fixture_r003,
+    "r003_bare_int8": fixture_r003_bare_int8,
+    "quant_scaled_allreduce": fixture_quant_scaled_allreduce,
     "r004": fixture_r004,
     "r005": fixture_r005,
     "r006": fixture_r006,

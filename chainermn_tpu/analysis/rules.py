@@ -24,10 +24,18 @@ from chainermn_tpu.analysis.core import (
     iter_eqns_with_path,
     register_rule,
 )
-from chainermn_tpu.observability.hlo_audit import REDUCTION_PRIMITIVES
+from chainermn_tpu.observability.hlo_audit import (
+    REDUCTION_PRIMITIVES,
+    _eqn_axes,
+)
 
 #: dtypes whose reduction accumulates in reduced precision on the wire.
 NARROW_DTYPES = ("bfloat16", "float16")
+
+#: quantized wire dtypes produced by ``comm_dtype=`` — legitimate ONLY
+#: inside the blessed scale→cast→reduce→cast→unscale pattern, whose
+#: tell is the per-bucket amax ``pmax`` exchange over the same axes.
+QUANT_WIRE_DTYPES = ("int8", "float8_e4m3fn", "float8_e4m3")
 
 #: below this leaf count the per-leaf and bucketed lowerings coincide,
 #: so R004 cannot (and need not) distinguish them.
@@ -169,10 +177,25 @@ def check_unreduced_gradient(ctx: LintContext) -> List[Finding]:
     )]
 
 
+def _pmax_axes(ctx: LintContext) -> set:
+    """Axis tuples over which the program exchanges a ``pmax``.
+
+    ``pmax`` is not a :data:`COLLECTIVE_PRIMITIVES` member (it never
+    carries gradient payload), so it is invisible to ``ctx.events()``;
+    the amax exchange of the scaled-quantization pattern has to be
+    found by walking the jaxpr directly.
+    """
+    axes = set()
+    for _, eqn in iter_eqns_with_path(ctx.jaxpr):
+        if eqn.primitive.name == "pmax":
+            axes.add(tuple(str(a) for a in _eqn_axes(eqn)))
+    return axes
+
+
 @register_rule(
     "R003", "narrow-dtype-reduction",
-    "psum/psum_scatter accumulates a bf16/fp16 payload without an "
-    "explicit allreduce_grad_dtype opt-in",
+    "psum/psum_scatter accumulates a bf16/fp16 or bare int8/fp8 payload "
+    "without an explicit opt-in or the scaled-quantization pattern",
 )
 def check_narrow_dtype_reduction(ctx: LintContext) -> List[Finding]:
     # An explicit allreduce_grad_dtype is the sanctioned way to trade
@@ -181,9 +204,56 @@ def check_narrow_dtype_reduction(ctx: LintContext) -> List[Finding]:
     if ctx.comm is not None and \
             getattr(ctx.comm, "allreduce_grad_dtype", None) is not None:
         return []
+    # Likewise a resolved comm_dtype (ctor / env / tuned) declares the
+    # quantized wire intentionally: the communicator itself emits the
+    # blessed scale→cast→reduce→cast→unscale sequence.
+    comm_quant = None
+    if ctx.comm is not None:
+        try:
+            resolve = getattr(ctx.comm, "resolve_comm_dtype", None)
+            comm_quant = resolve() if callable(resolve) else None
+        except Exception:
+            comm_quant = None
+    pmax_axes = None  # computed lazily — most programs have no quant wire
     findings = []
     for e in ctx.events():
-        if e.name not in REDUCTION_PRIMITIVES or e.dtype not in NARROW_DTYPES:
+        if e.name not in REDUCTION_PRIMITIVES:
+            continue
+        if e.dtype in QUANT_WIRE_DTYPES:
+            # Quantized wire.  Blessed when the communicator opted in,
+            # or when the same program exchanges a pmax over the same
+            # axes — the per-bucket amax agreement that makes the
+            # narrow sum exact-mean-preserving.  A bare int8/fp8
+            # reduction with neither is an unscaled sum: it wraps
+            # (int8) or saturates (fp8) as the world grows.
+            if comm_quant is not None:
+                continue
+            if pmax_axes is None:
+                pmax_axes = _pmax_axes(ctx)
+            # The scale is sound when amax agreement covers at least
+            # the axes being reduced (hierarchical/2D lowerings reduce
+            # over sub-axes of the pmax'd data-parallel axes).
+            if any(set(e.axes) <= set(p) for p in pmax_axes):
+                continue
+            findings.append(Finding(
+                rule="R003", severity=SEVERITY_ERROR,
+                message=(
+                    f"{e.name} reduces a bare {e.dtype} payload of "
+                    f"shape {list(e.shape)} with no amax scale "
+                    "exchange: an unscaled narrow sum wraps or "
+                    "saturates as the world grows"
+                ),
+                eqn_path=e.path, axes=e.axes, bytes=e.bytes,
+                fix_hint=(
+                    "use comm_dtype= on the communicator (or "
+                    "CHAINERMN_TPU_COMM_DTYPE) so the reduction is "
+                    "wrapped in the scaled pattern: pmax the bucket "
+                    "amax, divide by the per-rank budget, reduce, "
+                    "rescale"
+                ),
+            ))
+            continue
+        if e.dtype not in NARROW_DTYPES:
             continue
         findings.append(Finding(
             rule="R003", severity=SEVERITY_ERROR,

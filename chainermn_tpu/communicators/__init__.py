@@ -34,7 +34,7 @@ from .naive import NaiveCommunicator
 from .single_host import SingleHostCommunicator, SingleNodeCommunicator
 from .two_dimensional import TwoDimensionalCommunicator
 from .xla_ici import FlatCommunicator, XlaIciCommunicator
-from . import mesh_utils, overlap, packing
+from . import mesh_utils, overlap, packing, quant
 from .mesh_utils import build_mesh
 from .overlap import OverlapSchedule, build_overlap_schedule
 from .packing import DEFAULT_BUCKET_BYTES, GradPacker, pack_tree
@@ -62,6 +62,7 @@ def create_communicator(
     scatter_inter: bool = False,
     overlap: bool | None = None,
     overlap_granularity: int | None = None,
+    comm_dtype: Any | None = None,
 ) -> CommunicatorBase:
     """Create a communicator by name (reference signature:
     ``create_communicator(communicator_name='hierarchical', mpi_comm=None,
@@ -85,6 +86,15 @@ def create_communicator(
     eager pack-all-then-reduce-all schedule (the ``--no-overlap`` A/B in
     bench.py).  ``overlap_granularity`` sets buckets emitted per
     schedule stage (``None`` = env → tuned → 1).
+
+    ``comm_dtype`` puts gradient buckets on a low-precision wire
+    (:mod:`chainermn_tpu.communicators.quant`): ``"int8"`` or ``"fp8"``
+    (e4m3 where the backend supports it, int8 fallback otherwise) scale
+    each packed bucket by its global amax, run the sum collective on
+    the narrow dtype, and dequantize in f32.  ``None`` resolves the
+    ``CHAINERMN_TPU_COMM_DTYPE`` env → tuned value → off; ``"none"``
+    pins it off.  Error vs the fp32 allreduce is bounded per dtype
+    (docs/performance.md).
     """
     try:
         cls = _COMMUNICATORS[communicator_name]
@@ -98,6 +108,7 @@ def create_communicator(
     kwargs: dict = dict(
         allreduce_grad_dtype=allreduce_grad_dtype, bucket_bytes=bucket_bytes,
         overlap=overlap, overlap_granularity=overlap_granularity,
+        comm_dtype=comm_dtype,
     )
     if scatter_inter:
         if not issubclass(cls, HierarchicalCommunicator):
@@ -123,6 +134,7 @@ __all__ = [
     "mesh_utils",
     "overlap",
     "packing",
+    "quant",
     "GradPacker",
     "OverlapSchedule",
     "build_overlap_schedule",

@@ -51,7 +51,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from . import kvtransport, mesh_utils, overlap as overlap_mod, packing
+from . import kvtransport, mesh_utils, overlap as overlap_mod, packing, quant
 
 try:  # jax >= 0.4.35
     from jax import shard_map as _shard_map_impl
@@ -143,6 +143,7 @@ class CommunicatorBase:
         bucket_bytes: int | None = None,
         overlap: bool | None = None,
         overlap_granularity: int | None = None,
+        comm_dtype: Any | None = None,
     ):
         # Subgroup membership (``split(color, key)``): the ordered GLOBAL
         # process indices participating in this communicator's host plane.
@@ -196,6 +197,11 @@ class CommunicatorBase:
                     f"{overlap_granularity}"
                 )
         self.overlap_granularity = overlap_granularity
+        # Low-precision gradient exchange (chainermn_tpu.communicators.
+        # quant): None = resolve at call time (CHAINERMN_TPU_COMM_DTYPE
+        # env -> tuned -> off), "none" pins it off, "int8"/"fp8" scale
+        # packed buckets onto that wire dtype around the sum collective.
+        self.comm_dtype = quant.canonical_comm_dtype(comm_dtype)
         # Seed the latency-hiding-scheduler / async-collective XLA flags
         # while they can still take effect (no-op off-TPU, after backend
         # init, or when overlap is off — see overlap.ensure_overlap_flags).
@@ -561,6 +567,15 @@ class CommunicatorBase:
         only the trace order changes so the buckets whose gradients the
         backward pass produces FIRST reduce while the rest still compute
         (see :mod:`chainermn_tpu.communicators.overlap`).
+
+        When a ``comm_dtype`` resolves (ctor -> ``CHAINERMN_TPU_COMM_DTYPE``
+        -> tuned), each float bucket is amax-scaled onto the narrow wire
+        dtype around its sum collective and dequantized in f32
+        (:mod:`chainermn_tpu.communicators.quant`) — bounded-error, not
+        bit-exact; the bound per dtype is documented in
+        docs/performance.md.  Quantization applies to the BUCKETED path
+        only: single-leaf trees and ``bucket_bytes=0`` keep the exact
+        full-precision lowering (no bucket boundary means no amax scope).
         """
         leaves = jax.tree.leaves(tree)
         if not leaves:
@@ -578,6 +593,68 @@ class CommunicatorBase:
 
     def _allreduce_impl(self, tree):
         raise NotImplementedError
+
+    def _allreduce_sum_impl(self, buf):
+        """Pure SUM over the world for one bucket buffer — the collective
+        leg the quantized path runs on the narrow wire dtype.  Separate
+        from ``_allreduce_impl`` because every variant's mean divides by
+        ``device_size`` inline, and integer division on an int8 buffer
+        would truncate toward zero and bias every gradient; the quantized
+        path applies the mean in f32 at dequant time instead.  Subclasses
+        with a multi-leg pattern (hierarchical, two_dimensional) override
+        with their characteristic sum chain.
+        """
+        return lax.psum(buf, self.axes)
+
+    def _allreduce_quantized(self, buf, wire_dt):
+        """One bucket through the blessed scale->cast->sum->cast->unscale
+        pattern (see :mod:`chainermn_tpu.communicators.quant`): global
+        amax via ``pmax``, world-headroom scale, narrow-dtype sum via
+        :meth:`_allreduce_sum_impl`, f32 dequant carrying the mean."""
+        world = self.device_size
+        q, scale = quant.quantize_for_allreduce(buf, wire_dt, self.axes, world)
+        qsum = self._allreduce_sum_impl(q)
+        return quant.dequantize_mean(qsum, scale, world, buf.dtype)
+
+    def resolve_comm_dtype(self, tree=None) -> str | None:
+        """Effective gradient wire dtype for one ``allreduce_grad`` call.
+
+        Resolution order mirrors :meth:`resolve_bucket_bytes`: the
+        constructor's ``comm_dtype`` if set ("none" pins off); else the
+        ``CHAINERMN_TPU_COMM_DTYPE`` environment override; else a tuned
+        value from the persistent tune cache (TPU runtime only — inert
+        under pytest and off-TPU); else off.  Returns a canonical name
+        from :data:`quant.COMM_DTYPE_CHOICES`, or ``None`` for off.
+        """
+        cd = self.comm_dtype
+        if cd is None:
+            env = os.environ.get(quant.ENV_COMM_DTYPE, "").strip()
+            if env:
+                try:
+                    cd = quant.canonical_comm_dtype(env)
+                except ValueError:
+                    cd = None
+        if cd is None and tree is not None:
+            cd = self._tuned_comm_dtype(tree)
+        return None if cd in (None, "none") else cd
+
+    def _tuned_comm_dtype(self, tree):
+        try:
+            from chainermn_tpu.tuning.autotune import lookup_comm_dtype
+        except Exception:  # pragma: no cover - tuning subsystem absent
+            return None
+        leaves = jax.tree.leaves(tree)
+        per_dtype: dict = {}
+        for l in leaves:
+            dt = np.dtype(l.dtype)
+            per_dtype[dt] = per_dtype.get(dt, 0) + int(l.size) * dt.itemsize
+        dominant = max(per_dtype, key=per_dtype.get)
+        return lookup_comm_dtype(
+            total_bytes=sum(per_dtype.values()),
+            n_leaves=len(leaves),
+            dtype=dominant,
+            communicator=self.name,
+        )
 
     def resolve_bucket_bytes(self, tree=None) -> int:
         """Effective bucket cap for one ``allreduce_grad`` call.
@@ -696,10 +773,22 @@ class CommunicatorBase:
         self._report_packing(packer)
         from chainermn_tpu.observability.spans import named_scope
 
+        # Low-precision wire: quantize each float bucket around its sum
+        # collective (quant.py's blessed pattern).  Integer buckets pass
+        # through at full precision, and the schedule below is untouched
+        # — scaled buckets still stage in reverse leaf-production order.
+        wire_dt = quant.wire_dtype(self.resolve_comm_dtype(tree))
+        self._report_quant(packer, wire_dt)
+
+        def reduce_bucket(buf):
+            if wire_dt is not None and quant.quantizable(buf.dtype):
+                return self._allreduce_quantized(buf, wire_dt)
+            return self._allreduce_impl(buf)
+
         if not self.resolve_overlap(overlap):
             with named_scope("grad-pack"):
                 bufs = packer.pack(tree)
-            outs = [self._allreduce_impl(b) for b in bufs]
+            outs = [reduce_bucket(b) for b in bufs]
             with named_scope("grad-unpack"):
                 return packer.unpack(outs)
 
@@ -712,7 +801,7 @@ class CommunicatorBase:
             with named_scope(f"grad-stage{s}"):
                 bufs = [packer.pack_bucket(leaves, i) for i in stage]
                 for i, buf in zip(stage, bufs):
-                    outs[i] = self._allreduce_impl(buf)
+                    outs[i] = reduce_bucket(buf)
         with named_scope("grad-unpack"):
             return packer.unpack(outs)
 
@@ -735,6 +824,30 @@ class CommunicatorBase:
             "grad_pack/pad_bytes", packer.padded_bytes - packer.payload_bytes
         )
         rep.histogram_observe("grad_pack/bucket_bytes", packer.bucket_bytes)
+
+    def _report_quant(self, packer, wire_dt) -> None:
+        """Publish the quantization plan (trace-time, like
+        :meth:`_report_packing`): how many buckets ride the narrow wire
+        and the bytes they move vs their full-precision payload."""
+        if wire_dt is None:
+            return
+        from chainermn_tpu.observability import reporter as _reporter
+        from chainermn_tpu.observability import spans as _spans
+
+        if not _spans.telemetry_active():
+            return
+        rep = _reporter.get_reporter()
+        if rep is None:  # pragma: no cover - raced deactivation
+            return
+        wire_size = jnp.dtype(wire_dt).itemsize
+        n_q = sum(
+            1 for b in packer.buckets if quant.quantizable(b.dtype)
+        )
+        rep.count("grad_pack/quant_buckets", n_q)
+        rep.count("grad_pack/quant_wire_bytes", sum(
+            b.padded_elems * wire_size
+            for b in packer.buckets if quant.quantizable(b.dtype)
+        ))
 
     def multi_node_mean(self, tree):
         """Alias matching later reference spellings of allreduce_grad."""
@@ -796,7 +909,13 @@ class CommunicatorBase:
 
             return body
 
-        return self._eager_cached("allreduce_grad", stacked_tree, make_body)
+        # The resolved wire dtype joins the cache key: toggling
+        # comm_dtype (attribute or env) between calls must retrace, not
+        # reuse the other precision's compiled collective.
+        return self._eager_cached(
+            ("allreduce_grad", self.resolve_comm_dtype()),
+            stacked_tree, make_body,
+        )
 
     def device_for_rank(self, r: int):
         """The device at flattened rank ``r`` (row-major over ``self.axes``,
@@ -1093,6 +1212,7 @@ class CommunicatorBase:
                 bucket_bytes=self.bucket_bytes,
                 overlap=self.overlap,
                 overlap_granularity=self.overlap_granularity,
+                comm_dtype=self.comm_dtype,
             )
         except ValueError:
             CommunicatorBase._plane_count = count
@@ -1105,6 +1225,7 @@ class CommunicatorBase:
                 bucket_bytes=self.bucket_bytes,
                 overlap=self.overlap,
                 overlap_granularity=self.overlap_granularity,
+                comm_dtype=self.comm_dtype,
             )
 
     def split_devices(self, colors, keys=None) -> dict:
@@ -1177,6 +1298,7 @@ class CommunicatorBase:
                 bucket_bytes=self.bucket_bytes,
                 overlap=self.overlap,
                 overlap_granularity=self.overlap_granularity,
+                comm_dtype=self.comm_dtype,
             )
         return out
 
@@ -1244,6 +1366,7 @@ class CommunicatorBase:
                 bucket_bytes=self.bucket_bytes,
                 overlap=self.overlap,
                 overlap_granularity=self.overlap_granularity,
+                comm_dtype=self.comm_dtype,
             )
         except ValueError:
             CommunicatorBase._plane_count = count
@@ -1256,6 +1379,7 @@ class CommunicatorBase:
                 bucket_bytes=self.bucket_bytes,
                 overlap=self.overlap,
                 overlap_granularity=self.overlap_granularity,
+                comm_dtype=self.comm_dtype,
             )
 
     def __repr__(self):

@@ -44,12 +44,13 @@ class HierarchicalCommunicator(CommunicatorBase):
     def __init__(self, mesh=None, axes=None, allreduce_grad_dtype=None,
                  host_members=None, bucket_bytes=None,
                  overlap=None, overlap_granularity=None,
-                 scatter_inter: bool = False):
+                 comm_dtype=None, scatter_inter: bool = False):
         super().__init__(mesh, axes, allreduce_grad_dtype,
                          host_members=host_members,
                          bucket_bytes=bucket_bytes,
                          overlap=overlap,
-                         overlap_granularity=overlap_granularity)
+                         overlap_granularity=overlap_granularity,
+                         comm_dtype=comm_dtype)
         if mesh_utils.AXIS_INTRA not in self.axes or mesh_utils.AXIS_INTER not in self.axes:
             raise ValueError(
                 "hierarchical communicator needs both 'inter' and 'intra' "
@@ -68,6 +69,32 @@ class HierarchicalCommunicator(CommunicatorBase):
             return g / n
 
         return jax.tree.map(leg, tree)
+
+    def _allreduce_sum_impl(self, buf):
+        """The quantized path's sum-only leg: the same two chained psums
+        (intra then inter — both exact on the narrow wire dtype thanks to
+        quant.py's world-headroom scale), WITHOUT the inline mean — int8
+        division would truncate; dequant applies the mean in f32.  The
+        ``scatter_inter`` decomposition runs its reduce-scatter chain on
+        the wire dtype directly (zero padding is exact in any dtype)."""
+        if self.scatter_inter:
+            k = self.intra_size
+            n = buf.size
+            pad = (-n) % k
+            if pad:
+                buf = jnp.concatenate(
+                    [buf, jnp.zeros((pad,), buf.dtype)]
+                )
+            shard = lax.psum_scatter(
+                buf, mesh_utils.AXIS_INTRA, scatter_dimension=0, tiled=True
+            )
+            shard = lax.psum(shard, mesh_utils.AXIS_INTER)
+            full = lax.all_gather(
+                shard, mesh_utils.AXIS_INTRA, axis=0, tiled=True
+            )
+            return full[:n]
+        buf = lax.psum(buf, mesh_utils.AXIS_INTRA)
+        return lax.psum(buf, mesh_utils.AXIS_INTER)
 
     def _scatter_leg(self, g):
         k = self.intra_size

@@ -125,6 +125,22 @@ class Bucket:
     def padded_bytes(self) -> int:
         return self.padded_elems * self.dtype.itemsize
 
+    @property
+    def quantizable(self) -> bool:
+        """Whether a ``comm_dtype`` wire applies to this bucket: float
+        buckets (including the ml_dtypes extension floats, bf16/fp8)
+        quantize; integer buckets ride at full precision."""
+        return bool(jnp.issubdtype(self.dtype, jnp.floating))
+
+    def wire_bytes(self, wire_itemsize: int | None = None) -> int:
+        """Bytes this bucket actually moves per collective: the padded
+        buffer at the wire dtype's width when quantized (plus the f32
+        amax scale, one word per bucket), the padded storage bytes
+        otherwise."""
+        if wire_itemsize is None or not self.quantizable:
+            return self.padded_bytes
+        return self.padded_elems * wire_itemsize + 4
+
 
 class GradPacker:
     """Bucketed pack/unpack plan for one gradient pytree structure.
@@ -213,10 +229,33 @@ class GradPacker:
     def padded_bytes(self) -> int:
         return sum(b.padded_bytes for b in self.buckets)
 
-    def describe(self) -> dict:
+    def wire_bytes(self, comm_dtype=None) -> int:
+        """Total bytes one allreduce moves per rank: padded storage
+        bytes at full precision, or each quantizable bucket at the
+        resolved wire dtype's width (``comm_dtype``: a canonical name
+        from :mod:`chainermn_tpu.communicators.quant`) — the number
+        bench's A/B column reports against the bf16 baseline."""
+        wire_itemsize = None
+        if comm_dtype is not None:
+            from . import quant
+
+            wire_dt = quant.wire_dtype(comm_dtype)
+            if wire_dt is not None:
+                wire_itemsize = jnp.dtype(wire_dt).itemsize
+        return sum(b.wire_bytes(wire_itemsize) for b in self.buckets)
+
+    def describe(self, comm_dtype=None) -> dict:
         """JSON-friendly plan summary (what benches and the Reporter
-        counters publish)."""
-        return {
+        counters publish).  ``comm_dtype`` (canonical quant name) adds
+        the low-precision wire accounting per bucket."""
+        wire_itemsize = None
+        if comm_dtype is not None:
+            from . import quant
+
+            wire_dt = quant.wire_dtype(comm_dtype)
+            if wire_dt is not None:
+                wire_itemsize = jnp.dtype(wire_dt).itemsize
+        out = {
             "bucket_bytes": self.bucket_bytes,
             "n_leaves": self.n_leaves,
             "n_buckets": self.n_buckets,
@@ -233,6 +272,13 @@ class GradPacker:
                 for b in self.buckets
             ],
         }
+        if wire_itemsize is not None:
+            out["comm_dtype"] = comm_dtype
+            out["wire_bytes"] = self.wire_bytes(comm_dtype)
+            for spec, b in zip(out["buckets"], self.buckets):
+                spec["quantized"] = b.quantizable
+                spec["wire_bytes"] = b.wire_bytes(wire_itemsize)
+        return out
 
     # -- pack / unpack ------------------------------------------------
     def _check_tree(self, tree):

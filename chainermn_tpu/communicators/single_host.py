@@ -22,12 +22,13 @@ class SingleHostCommunicator(CommunicatorBase):
 
     def __init__(self, mesh=None, axes=None, allreduce_grad_dtype=None,
                  host_members=None, bucket_bytes=None,
-                 overlap=None, overlap_granularity=None):
+                 overlap=None, overlap_granularity=None, comm_dtype=None):
         super().__init__(mesh, axes, allreduce_grad_dtype,
                          host_members=host_members,
                          bucket_bytes=bucket_bytes,
                          overlap=overlap,
-                         overlap_granularity=overlap_granularity)
+                         overlap_granularity=overlap_granularity,
+                         comm_dtype=comm_dtype)
         if self.inter_size != 1 and mesh_utils.AXIS_INTER in self.axes:
             raise ValueError(
                 "single_host communicator requires inter_size == 1 "

@@ -34,17 +34,36 @@ class TwoDimensionalCommunicator(CommunicatorBase):
 
     def __init__(self, mesh=None, axes=None, allreduce_grad_dtype=None,
                  host_members=None, bucket_bytes=None,
-                 overlap=None, overlap_granularity=None):
+                 overlap=None, overlap_granularity=None, comm_dtype=None):
         super().__init__(mesh, axes, allreduce_grad_dtype,
                          host_members=host_members,
                          bucket_bytes=bucket_bytes,
                          overlap=overlap,
-                         overlap_granularity=overlap_granularity)
+                         overlap_granularity=overlap_granularity,
+                         comm_dtype=comm_dtype)
         if mesh_utils.AXIS_INTRA not in self.axes or mesh_utils.AXIS_INTER not in self.axes:
             raise ValueError(
                 "two_dimensional communicator needs both 'inter' and 'intra' "
                 f"mesh axes; got {self.axes}"
             )
+
+    def _allreduce_sum_impl(self, buf):
+        """Sum-only leg for the quantized path: the same reduce-scatter /
+        inter-psum / all-gather chain on the narrow wire dtype (the
+        world-headroom scale in quant.py keeps every partial sum in
+        range; zero padding is exact in any dtype), WITHOUT the inline
+        mean — dequant applies it in f32."""
+        k = self.intra_size
+        n = buf.size
+        pad = (-n) % k
+        if pad:
+            buf = jnp.concatenate([buf, jnp.zeros((pad,), buf.dtype)])
+        shard = lax.psum_scatter(
+            buf, mesh_utils.AXIS_INTRA, scatter_dimension=0, tiled=True
+        )
+        shard = lax.psum(shard, mesh_utils.AXIS_INTER)
+        full = lax.all_gather(shard, mesh_utils.AXIS_INTRA, axis=0, tiled=True)
+        return full[:n]
 
     def _allreduce_impl(self, tree):
         leaves = jax.tree.leaves(tree)

@@ -59,6 +59,11 @@ class MultiHeadAttention(nn.Module):
                                  # token, paged single-query attention)
     page_count: int = 0          # number of cache pages (paged modes)
     page_size: int = 0           # tokens per page (paged modes)
+    kv_dtype: Optional[str] = None  # quantized pages: "int8" stores K/V
+                                    # pages as int8 with per-token-per-head
+                                    # fp32 scales ("k_scales"/"v_scales"
+                                    # cache leaves); None = pages in the
+                                    # compute dtype
 
     @nn.compact
     def __call__(self, q_in, kv_in, mask=None, *, block_tables=None,
@@ -115,24 +120,83 @@ class MultiHeadAttention(nn.Module):
                 raise ValueError(
                     "paged modes require block_tables and seq_lens"
                 )
+            if self.kv_dtype not in (None, "int8"):
+                raise ValueError(
+                    f"kv_dtype must be None or 'int8', got "
+                    f"{self.kv_dtype!r}"
+                )
+            from chainermn_tpu.communicators.quant import (
+                dequantize_kv,
+                quantize_kv,
+            )
+
+            # Quantized pages (kv_dtype="int8", docs/serving.md): K/V
+            # pages store int8 payloads with a per-token-per-head fp32
+            # scale leaf alongside — the scale pages share the page
+            # geometry's leading (page, slot) axes, so the SAME scatter
+            # writes and the same block-table gather route them.
+            page_dt = jnp.int8 if self.kv_dtype else k.dtype
             pages = (self.page_count, self.page_size, n_kv, d_head)
             pk = self.variable(
-                "cache", "k_pages", lambda: jnp.zeros(pages, k.dtype)
+                "cache", "k_pages", lambda: jnp.zeros(pages, page_dt)
             )
             pv = self.variable(
-                "cache", "v_pages", lambda: jnp.zeros(pages, v.dtype)
+                "cache", "v_pages", lambda: jnp.zeros(pages, page_dt)
             )
+            sk = sv = None
+            if self.kv_dtype:
+                sshape = (self.page_count, self.page_size, n_kv)
+                sk = self.variable(
+                    "cache", "k_scales",
+                    lambda: jnp.zeros(sshape, jnp.float32),
+                )
+                sv = self.variable(
+                    "cache", "v_scales",
+                    lambda: jnp.zeros(sshape, jnp.float32),
+                )
+
+            def write_kv(writer, lens):
+                # One write path for all three paged modes: quantize the
+                # fresh K/V (when kv_dtype is on) and scatter payloads
+                # and scales through the same (page, slot) routing.
+                if not self.kv_dtype:
+                    pk.value = writer(pk.value, k, block_tables, lens)
+                    pv.value = writer(pv.value, v, block_tables, lens)
+                    return
+                qk, k_sc = quantize_kv(k)
+                qv, v_sc = quantize_kv(v)
+                pk.value = writer(pk.value, qk, block_tables, lens)
+                pv.value = writer(pv.value, qv, block_tables, lens)
+                sk.value = writer(sk.value, k_sc, block_tables, lens)
+                sv.value = writer(sv.value, v_sc, block_tables, lens)
+                # Round-trip quantization error of this write — the
+                # ``serve/kv_quant_err`` gauge's source (engine pulls the
+                # "intermediates" collection when kv_dtype is on).
+                err = jnp.maximum(
+                    jnp.max(jnp.abs(dequantize_kv(qk, k_sc, jnp.float32)
+                                    - k.astype(jnp.float32))),
+                    jnp.max(jnp.abs(dequantize_kv(qv, v_sc, jnp.float32)
+                                    - v.astype(jnp.float32))),
+                )
+                self.sow("intermediates", "kv_quant_err", err)
+
+            def scales():
+                # Read AFTER write_kv, so the freshly-written slots carry
+                # this step's scales, not the pre-write zeros.
+                return dict(
+                    k_scales=sk.value if self.kv_dtype else None,
+                    v_scales=sv.value if self.kv_dtype else None,
+                )
+
             if self.paged == "prefill":
                 # Write the whole prompt's K/V (padding positions beyond
                 # seq_lens route to the invalid page and are dropped);
                 # the attention itself is the ordinary dense causal path
-                # over the local K/V — the prompt IS the whole context.
-                pk.value = write_prompt_pages(
-                    pk.value, k, block_tables, seq_lens
-                )
-                pv.value = write_prompt_pages(
-                    pv.value, v, block_tables, seq_lens
-                )
+                # over the local K/V — the prompt IS the whole context,
+                # and it is still local in full precision (quantization
+                # error enters only when pages are READ back: decode,
+                # chunk, and prefix-cached suffix prefill).
+                write_kv(write_prompt_pages, seq_lens)
             elif self.paged == "chunk":
                 # Verify/suffix-prefill mode: T consecutive tokens per
                 # sequence starting at position ``seq_lens[b]`` (here the
@@ -140,18 +204,14 @@ class MultiHeadAttention(nn.Module):
                 # written first, then each query attends with its own
                 # causal bound — exactly what T sequential decode steps
                 # would have seen, in one lowering.
-                pk.value = write_chunk_pages(
-                    pk.value, k, block_tables, seq_lens
-                )
-                pv.value = write_chunk_pages(
-                    pv.value, v, block_tables, seq_lens
-                )
+                write_kv(write_chunk_pages, seq_lens)
                 out = paged_attention_chunk(
                     q, pk.value, pv.value, block_tables, seq_lens,
                     block_ctx=_tuned_block_ctx(
                         self.page_count, self.page_size, n_kv, d_head,
                         q.dtype,
                     ),
+                    **scales(),
                 )
                 return nn.DenseGeneral(
                     self.d_model, axis=(-2, -1), dtype=self.dtype,
@@ -163,18 +223,14 @@ class MultiHeadAttention(nn.Module):
                         f"paged decode consumes exactly one token per "
                         f"call, got a length-{q.shape[1]} chunk"
                     )
-                pk.value = write_token_pages(
-                    pk.value, k, block_tables, seq_lens
-                )
-                pv.value = write_token_pages(
-                    pv.value, v, block_tables, seq_lens
-                )
+                write_kv(write_token_pages, seq_lens)
                 out = paged_attention_decode(
                     q, pk.value, pv.value, block_tables, seq_lens + 1,
                     block_ctx=_tuned_block_ctx(
                         self.page_count, self.page_size, n_kv, d_head,
                         q.dtype,
                     ),
+                    **scales(),
                 )
                 return nn.DenseGeneral(
                     self.d_model, axis=(-2, -1), dtype=self.dtype,
@@ -272,6 +328,7 @@ class EncoderLayer(nn.Module):
     paged: Optional[str] = None
     page_count: int = 0
     page_size: int = 0
+    kv_dtype: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, mask=None, *, block_tables=None, seq_lens=None):
@@ -281,6 +338,7 @@ class EncoderLayer(nn.Module):
             decode=self.decode, cache_len=self.cache_len,
             n_kv_heads=self.n_kv_heads, paged=self.paged,
             page_count=self.page_count, page_size=self.page_size,
+            kv_dtype=self.kv_dtype,
         )(h, h, mask, block_tables=block_tables, seq_lens=seq_lens)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         return x + FeedForward(self.d_model, self.d_ff, self.dtype)(h)
@@ -370,6 +428,8 @@ class TransformerLM(nn.Module):
                                  # MultiHeadAttention.paged
     page_count: int = 0
     page_size: int = 0
+    kv_dtype: Optional[str] = None  # quantized pages ("int8") — see
+                                    # MultiHeadAttention.kv_dtype
 
     @nn.compact
     def __call__(self, tokens, position_offset=None, return_hidden=False,
@@ -452,6 +512,7 @@ class TransformerLM(nn.Module):
                 decode=self.decode, cache_len=self.max_len if self.decode else 0,
                 n_kv_heads=self.n_kv_heads, paged=self.paged,
                 page_count=self.page_count, page_size=self.page_size,
+                kv_dtype=self.kv_dtype,
             )(x, mask, block_tables=block_tables, seq_lens=seq_lens)
         x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
         if return_hidden:

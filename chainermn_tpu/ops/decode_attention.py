@@ -129,6 +129,8 @@ def paged_attention_decode(
     seq_lens,
     *,
     block_ctx: Optional[int] = None,
+    k_scales=None,
+    v_scales=None,
 ):
     """Single-query attention over paged K/V.
 
@@ -149,6 +151,11 @@ def paged_attention_decode(
     to bound the transient (B, ctx, Hkv, D) buffer; ``None`` gathers in
     one shot.  Chunking only the gather leaves the attention numerics
     untouched.
+
+    ``k_scales``/``v_scales``: (N, page_size, Hkv) fp32 per-token-per-head
+    scales for quantized (int8) pages — gathered through the same block
+    table and multiplied back in after the gather (``kv_dtype`` in
+    docs/serving.md).  ``None`` = pages are already in a compute dtype.
     """
     B, one, H, D = q.shape
     if one != 1:
@@ -158,7 +165,7 @@ def paged_attention_decode(
         )
     return paged_attention_chunk(
         q, k_pages, v_pages, block_tables, seq_lens - 1,
-        block_ctx=block_ctx,
+        block_ctx=block_ctx, k_scales=k_scales, v_scales=v_scales,
     )
 
 
@@ -170,6 +177,8 @@ def paged_attention_chunk(
     start_lens,
     *,
     block_ctx: Optional[int] = None,
+    k_scales=None,
+    v_scales=None,
 ):
     """Multi-query causal attention over paged K/V — the verify/suffix step.
 
@@ -184,28 +193,48 @@ def paged_attention_chunk(
     here, so single-token decode and multi-token verify share one
     lowering — bit-identical numerics at T=1 by construction.
 
+    ``k_scales``/``v_scales``: (N, page_size, Hkv) fp32 scales when the
+    pages are int8 (``kv_dtype``).  They ride the SAME gather (block
+    table, fill value 0) so an invalid slot dequantizes to exactly the
+    zeros the unquantized path gathers; the dequantized context is in
+    ``q.dtype`` before any einsum, so everything downstream of the
+    gather is byte-identical program structure to the full-precision
+    path.
+
     Returns (B, T, H, D) in ``q.dtype``.
     """
     B, T, H, D = q.shape
     N, page_size, Hkv, _ = k_pages.shape
     if H % Hkv:
         raise ValueError(f"n_kv_heads ({Hkv}) must divide n_heads ({H})")
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("k_scales and v_scales must be given together")
     W = block_tables.shape[1]
 
     def gather(pages, tables):
         g = jnp.take(pages, tables, axis=0, mode="fill", fill_value=0)
         return g.reshape(B, tables.shape[1] * page_size, Hkv, D)
 
+    def gather_deq(pages, scales, tables):
+        g = gather(pages, tables)
+        if scales is None:
+            return g
+        from chainermn_tpu.communicators.quant import dequantize_kv
+
+        s = jnp.take(scales, tables, axis=0, mode="fill", fill_value=0)
+        s = s.reshape(B, tables.shape[1] * page_size, Hkv)
+        return dequantize_kv(g, s, q.dtype)
+
     if block_ctx is None or block_ctx >= W:
-        k = gather(k_pages, block_tables)
-        v = gather(v_pages, block_tables)
+        k = gather_deq(k_pages, k_scales, block_tables)
+        v = gather_deq(v_pages, v_scales, block_tables)
     else:
         # Chunked gather: identical concatenated tensor, bounded transient.
         ks, vs = [], []
         for start in range(0, W, block_ctx):
             t = block_tables[:, start:start + block_ctx]
-            ks.append(gather(k_pages, t))
-            vs.append(gather(v_pages, t))
+            ks.append(gather_deq(k_pages, k_scales, t))
+            vs.append(gather_deq(v_pages, v_scales, t))
         k = jnp.concatenate(ks, axis=1)
         v = jnp.concatenate(vs, axis=1)
 

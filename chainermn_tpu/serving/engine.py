@@ -38,8 +38,36 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from chainermn_tpu.communicators import quant
 from chainermn_tpu.models.transformer import TransformerLM
 from chainermn_tpu.serving.kv_cache import PagedKVCache
+
+
+def _resolve_kv_dtype(cfg: "EngineConfig", lm: TransformerLM):
+    """``kv_dtype`` resolution, mirroring the comm side's ctor -> env ->
+    tuned -> off order: an explicit config value (any spelling,
+    including ``"none"``) wins outright; an unset one consults the
+    ``CHAINERMN_TPU_KV_DTYPE`` env, then the autotune cache (inert under
+    pytest / off-TPU)."""
+    import os
+
+    if cfg.kv_dtype is not None:
+        return quant.canonical_kv_dtype(cfg.kv_dtype)
+    env = os.environ.get(quant.ENV_KV_DTYPE)
+    if env is not None:
+        try:
+            return quant.canonical_kv_dtype(env)
+        except ValueError:
+            return None
+    try:
+        from chainermn_tpu.tuning import lookup_kv_dtype
+    except ImportError:  # pragma: no cover - partial installs
+        return None
+    n_kv = lm.n_kv_heads or lm.n_heads
+    return lookup_kv_dtype(
+        n_pages=cfg.n_blocks, page_size=cfg.block_size, n_kv=n_kv,
+        d_head=lm.d_model // lm.n_heads, dtype=lm.dtype,
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,6 +104,12 @@ class EngineConfig:
     max_batch: int = 8
     #: enable the prefix index / CoW sharing in the page accounting.
     prefix_cache: bool = True
+    #: KV page storage dtype: ``"int8"`` stores pages quantized with
+    #: per-token-per-head scales (docs/serving.md — ~half the pool bytes
+    #: per token, bounded decode error); ``None`` resolves
+    #: ``CHAINERMN_TPU_KV_DTYPE`` -> tuned value -> model dtype;
+    #: ``"none"`` pins full precision.
+    kv_dtype: Optional[str] = None
     prefill_buckets: Optional[Tuple[int, ...]] = None
     batch_buckets: Optional[Tuple[int, ...]] = None
     table_width_buckets: Optional[Tuple[int, ...]] = None
@@ -141,11 +175,13 @@ class InferenceEngine:
         self.kv = PagedKVCache(cfg.n_blocks, cfg.block_size,
                                prefix_cache=cfg.prefix_cache)
 
+        self.kv_dtype = _resolve_kv_dtype(cfg, lm)
         twin = dict(
             vocab=lm.vocab, d_model=lm.d_model, n_heads=lm.n_heads,
             d_ff=lm.d_ff, n_layers=lm.n_layers, max_len=lm.max_len,
             dtype=lm.dtype, n_kv_heads=lm.n_kv_heads,
             page_count=cfg.n_blocks, page_size=cfg.block_size,
+            kv_dtype=self.kv_dtype,
         )
         self._prefill_model = TransformerLM(**twin, paged="prefill")
         self._decode_model = TransformerLM(**twin, paged="decode")
@@ -166,11 +202,24 @@ class InferenceEngine:
             lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
         )
 
+        # Quantized engines also pull the "intermediates" collection (the
+        # per-layer kv round-trip errors sown by MultiHeadAttention) and
+        # return their max, the serve/kv_quant_err gauge's source.  The
+        # default path keeps the exact two-output signature it always had.
+        kv_q = self.kv_dtype is not None
+        muts = ["cache", "intermediates"] if kv_q else ["cache"]
+
+        def _kv_err(upd):
+            leaves = jax.tree.leaves(upd.get("intermediates", {}))
+            if not leaves:
+                return jnp.zeros((), jnp.float32)
+            return jnp.max(jnp.stack([l.astype(jnp.float32) for l in leaves]))
+
         def prefill_step(params, cache, tokens, block_tables, seq_lens):
             logits, upd = self._prefill_model.apply(
                 {"params": params, "cache": cache}, tokens,
                 block_tables=block_tables, seq_lens=seq_lens,
-                mutable=["cache"],
+                mutable=muts,
             )
             # Logits of the LAST PROMPT TOKEN per row — what samples the
             # first generated token.  (Padding rows index position 0 of
@@ -181,6 +230,8 @@ class InferenceEngine:
                     idx, (logits.shape[0], 1, logits.shape[2])
                 ), axis=1,
             )[:, 0]
+            if kv_q:
+                return last.astype(jnp.float32), upd["cache"], _kv_err(upd)
             return last.astype(jnp.float32), upd["cache"]
 
         def decode_step(params, cache, tokens, block_tables, seq_lens):
@@ -188,8 +239,11 @@ class InferenceEngine:
                 {"params": params, "cache": cache}, tokens[:, None],
                 position_offset=jnp.maximum(seq_lens, 0)[:, None],
                 block_tables=block_tables, seq_lens=seq_lens,
-                mutable=["cache"],
+                mutable=muts,
             )
+            if kv_q:
+                return (logits[:, 0].astype(jnp.float32), upd["cache"],
+                        _kv_err(upd))
             return logits[:, 0].astype(jnp.float32), upd["cache"]
 
         def chunk_step(params, cache, tokens, block_tables, start_lens):
@@ -202,8 +256,10 @@ class InferenceEngine:
                 {"params": params, "cache": cache}, tokens,
                 position_offset=offs,
                 block_tables=block_tables, seq_lens=start_lens,
-                mutable=["cache"],
+                mutable=muts,
             )
+            if kv_q:
+                return logits.astype(jnp.float32), upd["cache"], _kv_err(upd)
             return logits.astype(jnp.float32), upd["cache"]
 
         def cow_step(cache, old, new):
@@ -228,6 +284,7 @@ class InferenceEngine:
         self._tokens_chunked = 0
         self._tokens_prefix_cached = 0
         self._cow_splits = 0
+        self._kv_quant_err = 0.0
 
         self.plan = None
         self.mesh = None
@@ -308,10 +365,13 @@ class InferenceEngine:
         padded[0, :L] = toks
         table = self.kv.padded_table(seq_id, W)[None]
         self._prefill_shapes.add((S, W))
-        last, self._cache = self._prefill_jit(
+        out = self._prefill_jit(
             self.params, self._cache, jnp.asarray(padded),
             jnp.asarray(table), jnp.asarray([L], np.int32),
         )
+        last, self._cache = out[0], out[1]
+        if self.kv_dtype is not None:
+            self._note_kv_err(out[2])
         self._tokens_prefilled += L
         return np.asarray(last[0])
 
@@ -345,10 +405,13 @@ class InferenceEngine:
         for i, sid in enumerate(seq_ids):
             tables[i] = self.kv.padded_table(sid, W)
         self._decode_shapes.add((Bp, W))
-        logits, self._cache = self._decode_jit(
+        out = self._decode_jit(
             self.params, self._cache, jnp.asarray(tok),
             jnp.asarray(tables), jnp.asarray(lens),
         )
+        logits, self._cache = out[0], out[1]
+        if self.kv_dtype is not None:
+            self._note_kv_err(out[2])
         self._tokens_decoded += B
         return np.asarray(logits[:B])
 
@@ -391,10 +454,13 @@ class InferenceEngine:
             start[i] = int(s)
             tables[i] = self.kv.padded_table(sid, W)
         self._chunk_shapes.add((Bp, T, W))
-        logits, self._cache = self._chunk_jit(
+        out = self._chunk_jit(
             self.params, self._cache, jnp.asarray(tok),
             jnp.asarray(tables), jnp.asarray(start),
         )
+        logits, self._cache = out[0], out[1]
+        if self.kv_dtype is not None:
+            self._note_kv_err(out[2])
         self._tokens_chunked += sum(len(r) for r in token_rows)
         return np.asarray(logits[:B])
 
@@ -443,6 +509,19 @@ class InferenceEngine:
         )
         self._cow_splits += 1
         return True
+
+    def _note_kv_err(self, err) -> None:
+        """Fold one step's KV round-trip quantization error into the
+        running max and publish the ``serve/kv_quant_err`` gauge when
+        telemetry is active (host-plane: gauges cannot be set in-jit)."""
+        self._kv_quant_err = max(self._kv_quant_err, float(err))
+        from chainermn_tpu.observability import reporter as _reporter
+        from chainermn_tpu.observability import spans as _spans
+
+        if _spans.telemetry_active():
+            rep = _reporter.get_reporter()
+            if rep is not None:
+                rep.gauge("serve/kv_quant_err", self._kv_quant_err)
 
     # -- sampling ------------------------------------------------------
     @staticmethod
@@ -508,6 +587,11 @@ class InferenceEngine:
             "tokens_prefix_cached": self._tokens_prefix_cached,
             "cow_splits": self._cow_splits,
         }
+        # Quantized-KV keys only when the feature is on, so the default
+        # stats shape (and everything golden-pinned to it) is unchanged.
+        if self.kv_dtype is not None:
+            out["kv_dtype"] = self.kv_dtype
+            out["kv_quant_err"] = self._kv_quant_err
         # Cross-check against jit's own cache where the API exists.
         for name, fn in (("prefill", self._prefill_jit),
                          ("decode", self._decode_jit),

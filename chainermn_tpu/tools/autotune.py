@@ -84,11 +84,32 @@ def main(argv=None) -> int:
                     help="also tune the backward-overlap schedule "
                          "(stage granularity x bucket_bytes; "
                          "communicators/overlap.py)")
+    # Quantized gradient wire (docs/performance.md "Quantized gradient
+    # wire") — shares the --ab-* tree-family flags.
+    ap.add_argument("--comm-dtype", action="store_true",
+                    help="also tune the gradient wire dtype "
+                         "(none/int8/fp8 scaled allreduce; "
+                         "communicators/quant.py)")
+    # Quantized KV pages (docs/serving.md "int8 KV cache").
+    ap.add_argument("--kv-dtype", action="store_true",
+                    help="also tune the serving KV page dtype "
+                         "(none/int8 quantized pages) for the "
+                         "--kv-* page geometry")
+    ap.add_argument("--kv-pages", type=int, default=512,
+                    help="pool pages (bench --serve-blocks)")
+    ap.add_argument("--kv-page-size", type=int, default=16,
+                    help="tokens per page (bench --serve-block-size)")
+    ap.add_argument("--kv-heads", type=int, default=None,
+                    help="KV heads (default: --heads)")
+    ap.add_argument("--kv-batch", type=int, default=8,
+                    help="decode rows for the timing probe")
     args = ap.parse_args(argv)
 
     from chainermn_tpu.tuning import (
         TuneCache,
         tune_allreduce_bucket,
+        tune_comm_dtype,
+        tune_kv_dtype,
         tune_lm_shapes,
         tune_overlap_schedule,
     )
@@ -134,6 +155,24 @@ def main(argv=None) -> int:
             repeats=args.repeats, log=log,
         )
         print(json.dumps({"overlap_schedule": rec}))
+    if args.comm_dtype:
+        rec = tune_comm_dtype(
+            communicator=args.ab_communicator, total_mb=args.ab_total_mb,
+            n_leaves=args.ab_leaves, dtype=args.dtype, cache=cache,
+            force=args.force, dry_run=args.dry_run, n1=args.n1,
+            repeats=args.repeats, log=log,
+        )
+        print(json.dumps({"comm_dtype": rec}))
+    if args.kv_dtype:
+        n_kv = args.kv_heads if args.kv_heads is not None else args.heads
+        rec = tune_kv_dtype(
+            n_pages=args.kv_pages, page_size=args.kv_page_size,
+            n_kv=n_kv, d_head=args.d_model // args.heads,
+            n_heads=args.heads, batch=args.kv_batch, dtype=args.dtype,
+            cache=cache, force=args.force, dry_run=args.dry_run,
+            n1=args.n1, repeats=args.repeats, log=log,
+        )
+        print(json.dumps({"kv_dtype": rec}))
     return 0
 
 

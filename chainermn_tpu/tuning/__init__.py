@@ -47,10 +47,14 @@ from chainermn_tpu.tuning.search_space import (  # noqa: F401
     bucket_search_space,
     ce_cache_key,
     ce_search_space,
+    comm_dtype_cache_key,
+    comm_dtype_search_space,
     decode_cache_key,
     decode_search_space,
     flash_cache_key,
     flash_search_space,
+    kv_dtype_cache_key,
+    kv_dtype_search_space,
     layout_cache_key,
     layout_search_space,
     overlap_cache_key,
@@ -59,14 +63,18 @@ from chainermn_tpu.tuning.search_space import (  # noqa: F401
 from chainermn_tpu.tuning.autotune import (  # noqa: F401
     lookup_bucket_bytes,
     lookup_ce_chunk,
+    lookup_comm_dtype,
     lookup_decode_block_ctx,
     lookup_flash_blocks,
+    lookup_kv_dtype,
     lookup_layout,
     lookup_overlap_schedule,
     tune_allreduce_bucket,
+    tune_comm_dtype,
     tune_decode_attention,
     tune_flash,
     tune_fused_ce,
+    tune_kv_dtype,
     tune_layout,
     tune_lm_shapes,
     tune_overlap_schedule,

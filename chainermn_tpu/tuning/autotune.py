@@ -31,11 +31,15 @@ from chainermn_tpu.tuning.search_space import (
     bucket_search_space,
     ce_cache_key,
     ce_search_space,
+    comm_dtype_cache_key,
+    comm_dtype_search_space,
     decode_cache_key,
     decode_search_space,
     flash_cache_key,
     flash_default_config,
     flash_search_space,
+    kv_dtype_cache_key,
+    kv_dtype_search_space,
     layout_cache_key,
     layout_search_space,
     overlap_cache_key,
@@ -168,6 +172,51 @@ def lookup_decode_block_ctx(*, n_pages: int, page_size: int, n_kv: int,
     except Exception:
         return None
     return bc if bc >= 1 else None
+
+
+def lookup_comm_dtype(*, total_bytes: int, n_leaves: int, dtype,
+                      communicator: str) -> Optional[str]:
+    """Tuned gradient wire dtype (canonical ``"int8"``/``"fp8"``) for
+    one (tree size, leaf count, dominant dtype, communicator) family, or
+    None (full precision) on a miss / off-TPU / under pytest.  Consulted
+    by ``CommunicatorBase.resolve_comm_dtype`` after the ctor and
+    ``CHAINERMN_TPU_COMM_DTYPE`` overrides — and like every lookup it is
+    inert under pytest, so tier-1 gradients never quantize by surprise."""
+    if not runtime_lookup_enabled():
+        return None
+    try:
+        entry = shared_cache().get(comm_dtype_cache_key(
+            device_kind(), dtype, total_bytes, n_leaves, communicator
+        ))
+        if not entry:
+            return None
+        from chainermn_tpu.communicators.quant import canonical_comm_dtype
+
+        cd = canonical_comm_dtype(str(entry["comm_dtype"]))
+    except Exception:
+        return None
+    return None if cd in (None, "none") else cd
+
+
+def lookup_kv_dtype(*, n_pages: int, page_size: int, n_kv: int,
+                    d_head: int, dtype) -> Optional[str]:
+    """Tuned KV page storage dtype (canonical ``"int8"``) for one page
+    geometry, or None (model dtype) on a miss / off-TPU / under pytest.
+    Consulted by the serving engine's ``kv_dtype`` resolution after the
+    config and ``CHAINERMN_TPU_KV_DTYPE`` overrides."""
+    if not runtime_lookup_enabled():
+        return None
+    try:
+        entry = shared_cache().get(kv_dtype_cache_key(
+            device_kind(), dtype, n_pages, page_size, n_kv, d_head
+        ))
+        if not entry:
+            return None
+        from chainermn_tpu.communicators.quant import canonical_kv_dtype
+
+        return canonical_kv_dtype(str(entry["kv_dtype"]))
+    except Exception:
+        return None
 
 
 def lookup_layout(*, mesh, n_params: int, n_leaves: int, dtype,
@@ -734,6 +783,192 @@ def tune_decode_attention(
          "batch": batch},
     )
     rec["kernel"] = "paged_decode"
+    return rec
+
+
+def tune_comm_dtype(
+    *,
+    communicator: str = "xla_ici",
+    total_mb: float = 64.0,
+    n_leaves: int = 64,
+    dtype="float32",
+    mesh=None,
+    cache: Optional[TuneCache] = None,
+    n1: int = 3,
+    repeats: int = 3,
+    force: bool = False,
+    dry_run: bool = False,
+    log: Optional[Callable[[str], None]] = None,
+) -> dict:
+    """Tune the gradient wire dtype (``comm_dtype``) for one tree family.
+
+    Times ``eager_allreduce_grad`` over the shared synthetic tree at
+    full precision and at each narrow wire dtype, persisting the argmin
+    under the key ``resolve_comm_dtype`` reads back on TPU.  Every
+    candidate's measured max-abs error vs the fp32 path is recorded in
+    the result (and the winner's in the cache entry) so an operator can
+    audit the accuracy cost of the picked wire — the per-dtype bounds in
+    ``communicators.quant`` hold regardless of what is picked."""
+    from chainermn_tpu.communicators.packing import synthetic_grad_tree
+    from chainermn_tpu.communicators.quant import measure_comm_quant_error
+
+    total_bytes = int(total_mb * 1024 * 1024)
+    tree = synthetic_grad_tree(n_leaves, total_bytes, dtypes=(dtype,))
+    total_bytes = sum(
+        l.size * l.dtype.itemsize for l in jax.tree_util.tree_leaves(tree)
+    )
+    space = comm_dtype_search_space()
+    default_cfg = {"comm_dtype": "none"}
+    key = comm_dtype_cache_key(
+        device_kind(), dtype, total_bytes, n_leaves, communicator
+    )
+    if dry_run:
+        return {"kernel": "comm_dtype", "dry_run": True, "key": key,
+                "candidates": space, "default": default_cfg}
+    _require_tuning_allowed("gradient wire dtype")
+    cache = cache or shared_cache()
+    cached = cache.get(key) if not force else None
+    if cached and cached.get("comm_dtype"):
+        return {"kernel": "comm_dtype", "key": key, "cached": True,
+                "chosen": {"comm_dtype": str(cached["comm_dtype"])}}
+
+    from chainermn_tpu.communicators import create_communicator
+    from chainermn_tpu.utils.profiling import sync
+
+    n = None
+    errs: dict = {}
+    if log:
+        log(f"comm_dtype {key}: {len(space)} candidates")
+
+    def build(cfg):
+        nonlocal n
+        comm = create_communicator(
+            communicator, mesh=mesh, comm_dtype=cfg["comm_dtype"]
+        )
+        n = comm.device_size
+        if cfg["comm_dtype"] != "none":
+            errs[cfg["comm_dtype"]] = measure_comm_quant_error(
+                comm, tree, publish=False
+            )
+        stacked = jax.tree_util.tree_map(
+            lambda l: jax.numpy.stack([jax.numpy.asarray(l)] * n), tree
+        )
+
+        def run(k):
+            t0 = time.perf_counter()
+            out = stacked
+            for _ in range(k):
+                out = comm.eager_allreduce_grad(out)
+            sync(jax.tree_util.tree_leaves(out)[0])
+            return time.perf_counter() - t0
+
+        return run
+
+    results = measure_candidates(build, space, n1=n1, repeats=repeats,
+                                 log=log)
+    rec = _finish(
+        key, results, default_cfg, cache,
+        {"kernel": "comm_dtype", "dtype": dtype_name(dtype),
+         "communicator": communicator, "total_bytes": total_bytes,
+         "n_leaves": n_leaves, "device_size": n,
+         "max_abs_err": errs},
+    )
+    rec["kernel"] = "comm_dtype"
+    rec["max_abs_err"] = errs
+    return rec
+
+
+def tune_kv_dtype(
+    *,
+    n_pages: int,
+    page_size: int,
+    n_kv: int,
+    d_head: int,
+    n_heads: Optional[int] = None,
+    batch: int = 8,
+    dtype="bfloat16",
+    cache: Optional[TuneCache] = None,
+    n1: int = 3,
+    repeats: int = 3,
+    force: bool = False,
+    dry_run: bool = False,
+    log: Optional[Callable[[str], None]] = None,
+) -> dict:
+    """Tune the KV page storage dtype for one page geometry.
+
+    Times :func:`~chainermn_tpu.ops.paged_attention_decode` over a full
+    table at the model dtype and at each quantized page dtype (int8
+    pages + fp32 scale gather + in-kernel dequant), persisting the
+    argmin under the key the serving engine's ``kv_dtype`` resolution
+    reads back on TPU.  Note the timing captures the dequant overhead
+    but not the capacity win — int8 pages halve pool bytes per token
+    (docs/serving.md), which is why an operator may pin ``int8`` even
+    when the step time ties."""
+    import numpy as np
+
+    space = kv_dtype_search_space()
+    default_cfg = {"kv_dtype": "none"}
+    key = kv_dtype_cache_key(
+        device_kind(), dtype, n_pages, page_size, n_kv, d_head
+    )
+    if dry_run:
+        return {"kernel": "kv_dtype", "dry_run": True, "key": key,
+                "candidates": space, "default": default_cfg}
+    _require_tuning_allowed("KV page dtype")
+    cache = cache or shared_cache()
+    cached = cache.get(key) if not force else None
+    if cached and cached.get("kv_dtype"):
+        return {"kernel": "kv_dtype", "key": key, "cached": True,
+                "chosen": {"kv_dtype": str(cached["kv_dtype"])}}
+
+    from chainermn_tpu.communicators.quant import quantize_kv
+    from chainermn_tpu.ops.decode_attention import paged_attention_decode
+    from chainermn_tpu.utils.profiling import sync
+
+    H = n_heads or n_kv
+    W = n_pages // max(1, batch)
+    rng = np.random.RandomState(0)
+    dt = dtype_name(dtype)
+    q = jax.numpy.asarray(rng.randn(batch, 1, H, d_head), dt)
+    kv_f = jax.numpy.asarray(rng.randn(n_pages, page_size, n_kv, d_head), dt)
+    vv_f = jax.numpy.asarray(rng.randn(n_pages, page_size, n_kv, d_head), dt)
+    kv_q, kv_s = quantize_kv(kv_f)
+    vv_q, vv_s = quantize_kv(vv_f)
+    tables = jax.numpy.asarray(
+        rng.permutation(n_pages)[: batch * W].reshape(batch, W), "int32"
+    )
+    lens = jax.numpy.full((batch,), W * page_size, "int32")
+    if log:
+        log(f"kv_dtype {key}: {len(space)} candidates")
+
+    def build(cfg):
+        quantized = cfg["kv_dtype"] != "none"
+        kp, vp = (kv_q, vv_q) if quantized else (kv_f, vv_f)
+        ks, vs = (kv_s, vv_s) if quantized else (None, None)
+        f = jax.jit(
+            lambda q, kp, vp, t, sl: paged_attention_decode(
+                q, kp, vp, t, sl, k_scales=ks, v_scales=vs
+            )
+        )
+
+        def run(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                o = f(q, kp, vp, tables, lens)
+            sync(o)
+            return time.perf_counter() - t0
+
+        return run
+
+    results = measure_candidates(build, space, n1=n1, repeats=repeats,
+                                 log=log)
+    rec = _finish(
+        key, results, default_cfg, cache,
+        {"kernel": "kv_dtype", "dtype": dt, "n_pages": n_pages,
+         "page_size": page_size, "n_kv": n_kv, "d_head": d_head,
+         "batch": batch},
+    )
+    rec["kernel"] = "kv_dtype"
     return rec
 
 

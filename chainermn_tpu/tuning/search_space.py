@@ -314,6 +314,61 @@ def overlap_cache_key(dev_kind: str, dtype, total_bytes: int,
     )
 
 
+def comm_dtype_search_space() -> List[dict]:
+    """Candidate ``{"comm_dtype"}`` configs for the gradient wire dtype:
+    ``"none"`` (full precision — the static default, pinned first so a
+    tuned pick can never lose to it) plus every canonical narrow wire
+    dtype.  Unlike the other spaces this one trades a little accuracy
+    (bounded per dtype, see ``communicators.quant``) for wire bytes, so
+    the tuner records the measured quantization error alongside the
+    timing for the operator to veto."""
+    from chainermn_tpu.communicators.quant import COMM_DTYPE_CHOICES
+
+    return [{"comm_dtype": "none"}] + [
+        {"comm_dtype": c} for c in COMM_DTYPE_CHOICES
+    ]
+
+
+def comm_dtype_cache_key(dev_kind: str, dtype, total_bytes: int,
+                         n_leaves: int, communicator: str) -> str:
+    """Cache key for the gradient wire dtype: same family signature as
+    :func:`bucket_cache_key` (the trade-off is a property of the same
+    tree family) under its own kernel tag."""
+    return make_key(
+        "comm_dtype",
+        dev_kind,
+        dtype,
+        (("b", bucket_pow2(total_bytes)), ("l", bucket_pow2(n_leaves))),
+        {"comm": str(communicator)},
+    )
+
+
+def kv_dtype_search_space() -> List[dict]:
+    """Candidate ``{"kv_dtype"}`` configs for KV page storage: ``"none"``
+    (model dtype — the static default) plus every canonical quantized
+    page dtype."""
+    from chainermn_tpu.communicators.quant import KV_DTYPE_CHOICES
+
+    return [{"kv_dtype": "none"}] + [
+        {"kv_dtype": c} for c in KV_DTYPE_CHOICES
+    ]
+
+
+def kv_dtype_cache_key(dev_kind: str, dtype, n_pages: int, page_size: int,
+                       n_kv: int, d_head: int) -> str:
+    """Cache key for the KV page dtype: same geometry signature as
+    :func:`decode_cache_key` (the decision is a property of the same
+    page shape) under its own kernel tag."""
+    return make_key(
+        "kv_dtype",
+        dev_kind,
+        dtype,
+        (("p", bucket_pow2(n_pages)), ("s", page_size), ("h", n_kv),
+         ("d", d_head)),
+        {},
+    )
+
+
 def layout_search_space(mesh_axes, params=None, mesh=None) -> List[dict]:
     """Candidate ``{"plan"}`` configs for the parameter-layout search:
     every registry sharding plan whose axes the mesh has — and, when a

@@ -56,7 +56,8 @@ def _fixture_report(name):
 # Seeded violations: every rule must catch its fixture
 # ----------------------------------------------------------------------
 @pytest.mark.parametrize(
-    "name", ["r001", "r002", "r003", "r004", "r005", "r006"]
+    "name",
+    ["r001", "r002", "r003", "r003_bare_int8", "r004", "r005", "r006"],
 )
 def test_seeded_fixture_flagged(name):
     t, report = _fixture_report(name)
@@ -93,6 +94,35 @@ def test_default_train_step_lints_clean(communicator, lint_clean):
     report = lint_clean(t["fn"], *t["args"], comm=t["comm"])
     # all five rules actually ran — a clean pass by skipping is no pass
     assert set(report.rules_run) == {"R001", "R002", "R003", "R004", "R005"}
+
+
+def test_scaled_quant_pattern_blessed_structurally():
+    """R003 recognizes the scale→cast→reduce→cast→unscale wire by its
+    amax pmax signature alone (the fixture carries no communicator),
+    and also through the comm_dtype suppression gate when the
+    communicator IS given."""
+    from chainermn_tpu.analysis import analyze_fn
+    from chainermn_tpu.analysis.fixtures import FIXTURES
+
+    t = FIXTURES["quant_scaled_allreduce"]()
+    report = analyze_fn(t["fn"], *t["args"], comm=None)
+    assert "R003" not in _flagged(report), report.render()
+
+    from chainermn_tpu.communicators import create_communicator
+    from chainermn_tpu.analysis.fixtures import _mesh
+
+    comm = create_communicator("xla_ici", mesh=_mesh(), comm_dtype="int8")
+    report = analyze_fn(t["fn"], *t["args"], comm=comm)
+    assert "R003" not in _flagged(report), report.render()
+
+
+def test_bare_int8_reduction_fires_r003():
+    """The bless is the pattern, not the dtype: an int8 psum with no
+    amax exchange and no comm_dtype opt-in is still an error."""
+    t, report = _fixture_report("r003_bare_int8")
+    f = next(f for f in report.findings if f.rule == "R003")
+    assert "int8" in f.message and "amax" in f.message
+    assert "comm_dtype" in f.fix_hint
 
 
 def test_allreduce_grad_dtype_sanctions_narrow_reduction():

@@ -1,0 +1,403 @@
+"""Low-precision everywhere: scaled int8/fp8 gradient allreduce and the
+int8 paged KV cache (communicators/quant.py + engine ``kv_dtype``).
+
+Two acceptance surfaces:
+
+1. **Comm half** — the quantized allreduce mean stays within the
+   DOCUMENTED per-dtype error bound vs the fp32 path, on every
+   communicator, and composes with the backward-overlap schedule
+   bit-exactly (quantization happens per bucket; overlap only reorders
+   bucket emission).
+2. **KV half** — int8 K/V pages with per-token-per-head scales produce
+   decode streams that match the full-precision engine token-for-token
+   on the test geometries (greedy AND sampled), and the scales travel
+   with their pages through CoW splits, defragmentation and migration.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.communicators import build_mesh, create_communicator
+from chainermn_tpu.communicators.packing import synthetic_grad_tree
+from chainermn_tpu.communicators import quant
+
+ALL_NAMES = ["naive", "flat", "xla_ici", "hierarchical", "two_dimensional"]
+COMM_DTYPES = ["int8", "fp8"]
+VOCAB = 32
+
+
+@pytest.fixture(scope="module")
+def mesh24(devices8):
+    return build_mesh(inter_size=2, intra_size=4, devices=devices8)
+
+
+def _stacked(tree, n):
+    return jax.tree.map(
+        lambda l: jnp.stack(
+            [jnp.asarray(l) + jnp.asarray(r, l.dtype) for r in range(n)]
+        ),
+        tree,
+    )
+
+
+# ----------------------------------------------------------------------
+# Scaling core units (no mesh)
+# ----------------------------------------------------------------------
+def test_canonical_comm_dtype_names():
+    assert quant.canonical_comm_dtype(None) is None       # unset
+    assert quant.canonical_comm_dtype("none") == "none"   # pinned off
+    assert quant.canonical_comm_dtype("off") == "none"
+    assert quant.canonical_comm_dtype("bf16") == "none"
+    assert quant.canonical_comm_dtype("INT8") == "int8"
+    assert quant.canonical_comm_dtype("s8") == "int8"
+    assert quant.canonical_comm_dtype("e4m3") == "fp8"
+    assert quant.canonical_comm_dtype("float8_e4m3fn") == "fp8"
+    assert quant.canonical_comm_dtype("e2m1") == "fp8"    # fp4 -> fp8 path
+    with pytest.raises(ValueError, match="comm_dtype"):
+        quant.canonical_comm_dtype("int4")
+
+
+def test_canonical_kv_dtype_names():
+    assert quant.canonical_kv_dtype(None) is None
+    assert quant.canonical_kv_dtype("none") is None
+    assert quant.canonical_kv_dtype("bfloat16") is None
+    assert quant.canonical_kv_dtype("int8") == "int8"
+    assert quant.canonical_kv_dtype("S8") == "int8"
+    with pytest.raises(ValueError, match="kv_dtype"):
+        quant.canonical_kv_dtype("fp8")  # KV pages are int8-only
+
+
+def test_per_rank_qmax_is_an_integer_budget():
+    """127/8 = 15.875 would round UP to 16 on the worst rank and the
+    8-rank sum 128 wraps int8 — the budget must floor to an integer."""
+    assert quant.per_rank_qmax(jnp.int8, 8) == 15.0
+    assert quant.per_rank_qmax(jnp.int8, 1) == 127.0
+    assert quant.per_rank_qmax(jnp.int8, 127) == 1.0
+    assert quant.per_rank_qmax(jnp.int8, 500) == 1.0  # floor, never 0
+    for world in (1, 2, 8, 64):
+        b = quant.per_rank_qmax(jnp.int8, world)
+        assert b == np.floor(b) and b * world <= 127.0
+
+
+def test_roundtrip_within_bound_world1():
+    rng = np.random.default_rng(0)
+    buf = jnp.asarray(rng.normal(0, 3.0, size=4096), jnp.float32)
+    amax = float(jnp.max(jnp.abs(buf)))
+    for cd in COMM_DTYPES:
+        wdt = quant.wire_dtype(cd)
+        scale = quant.scale_for(jnp.asarray([amax], jnp.float32), wdt, 1)
+        q = quant.quantize(buf, scale, wdt)
+        back = quant.dequantize_mean(q, scale, 1, jnp.float32)
+        bound = float(quant.error_bound(cd, amax, 1)) * (1 + 1e-6)
+        assert float(jnp.max(jnp.abs(back - buf))) <= bound, cd
+
+
+def test_zero_bucket_roundtrips_exactly():
+    buf = jnp.zeros((256,), jnp.float32)
+    for cd in COMM_DTYPES:
+        wdt = quant.wire_dtype(cd)
+        amax = quant.local_amax(buf)
+        scale = quant.scale_for(amax, wdt, 8)
+        assert float(scale[0]) == 1.0  # zero-amax guard: finite divide
+        q = quant.quantize(buf, scale, wdt)
+        back = quant.dequantize_mean(q, scale, 8, jnp.float32)
+        assert float(jnp.max(jnp.abs(back))) == 0.0
+
+
+def test_kv_quantize_roundtrip_bound_and_exact_zeros():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(0, 2.0, size=(2, 6, 2, 8)), jnp.float32)
+    q, scales = quant.quantize_kv(x)
+    assert q.dtype == jnp.int8 and q.shape == x.shape
+    assert scales.dtype == jnp.float32 and scales.shape == x.shape[:-1]
+    back = quant.dequantize_kv(q, scales, jnp.float32)
+    # per-(token, head) bound: half a quantization step of that row's amax
+    amax = np.max(np.abs(np.asarray(x)), axis=-1, keepdims=True)
+    err = np.abs(np.asarray(back) - np.asarray(x))
+    assert np.all(err <= amax / (2 * 127.0) * (1 + 1e-6))
+    # zero payload + zero scale (untouched slots) -> exact zeros
+    z, zs = quant.quantize_kv(jnp.zeros_like(x))
+    assert float(jnp.max(jnp.abs(
+        quant.dequantize_kv(z, jnp.zeros_like(zs), jnp.float32)
+    ))) == 0.0
+
+
+# ----------------------------------------------------------------------
+# Comm half: bounded error on every communicator, overlap composition
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("cd", COMM_DTYPES)
+@pytest.mark.parametrize("name", ALL_NAMES)
+def test_quantized_allreduce_within_documented_bound(mesh24, name, cd):
+    """The ISSUE's acceptance bound: quantized mean vs fp32 mean within
+    ``error_bound(dtype, amax, world)`` on all five communicators."""
+    tree = synthetic_grad_tree(12, 256 * 1024)
+    comm = create_communicator(
+        name, mesh=mesh24, bucket_bytes=32 * 1024, comm_dtype=cd,
+    )
+    err = quant.measure_comm_quant_error(comm, tree, publish=False)
+    amax = max(
+        float(jnp.max(jnp.abs(l.astype(jnp.float32))))
+        for l in jax.tree.leaves(tree)
+    )
+    bound = float(quant.error_bound(cd, amax, comm.device_size))
+    assert err <= bound * (1 + 1e-6), (name, cd, err, bound)
+    assert err > 0.0  # the wire really was narrow
+
+
+@pytest.mark.parametrize("granularity", [1, 3])
+def test_quantized_overlap_matches_eager_bit_exact(mesh24, granularity):
+    """comm_dtype composes with the overlap schedule: per-bucket
+    quantization is emission-order-invariant, so overlapped and eager
+    quantized allreduce are byte-identical."""
+    tree = synthetic_grad_tree(12, 256 * 1024)
+    overlapped = create_communicator(
+        "xla_ici", mesh=mesh24, bucket_bytes=32 * 1024, comm_dtype="int8",
+        overlap=True, overlap_granularity=granularity,
+    )
+    eager = create_communicator(
+        "xla_ici", mesh=mesh24, bucket_bytes=32 * 1024, comm_dtype="int8",
+        overlap=False,
+    )
+    stacked = _stacked(tree, overlapped.device_size)
+    out_o = overlapped.eager_allreduce_grad(stacked)
+    out_e = eager.eager_allreduce_grad(stacked)
+    for k in tree:
+        a, b = np.asarray(out_o[k]), np.asarray(out_e[k])
+        assert a.dtype == b.dtype, k
+        np.testing.assert_array_equal(
+            a.reshape(-1).view(np.uint8), b.reshape(-1).view(np.uint8),
+            err_msg=k,
+        )
+
+
+def test_comm_dtype_ctor_env_resolution(mesh24, monkeypatch):
+    """Resolution order: ctor beats env; ctor "none" PINS off; unset
+    falls through to the env."""
+    monkeypatch.delenv(quant.ENV_COMM_DTYPE, raising=False)
+    comm = create_communicator("naive", mesh=mesh24)
+    assert comm.resolve_comm_dtype() is None  # default: full precision
+
+    monkeypatch.setenv(quant.ENV_COMM_DTYPE, "int8")
+    assert comm.resolve_comm_dtype() == "int8"
+
+    pinned_off = create_communicator("naive", mesh=mesh24,
+                                     comm_dtype="none")
+    assert pinned_off.resolve_comm_dtype() is None
+
+    pinned_fp8 = create_communicator("naive", mesh=mesh24,
+                                     comm_dtype="fp8")
+    monkeypatch.setenv(quant.ENV_COMM_DTYPE, "none")
+    assert pinned_fp8.resolve_comm_dtype() == "fp8"
+
+    with pytest.raises(ValueError, match="comm_dtype"):
+        create_communicator("naive", mesh=mesh24, comm_dtype="int4")
+
+
+def test_quantized_equals_full_precision_on_identical_ranks_worst_case(
+        mesh24):
+    """Identical values on every rank is the worst case for int8: every
+    rank rounds the SAME direction, the mean keeps the full per-rank
+    rounding error — the bound must still hold with equality allowed."""
+    tree = {"w": jnp.full((1024,), 4.5, jnp.float32)}
+    comm = create_communicator("xla_ici", mesh=mesh24, comm_dtype="int8")
+    err = quant.measure_comm_quant_error(comm, tree, publish=False)
+    bound = float(quant.error_bound("int8", 4.5, comm.device_size))
+    assert err <= bound * (1 + 1e-6)
+
+
+# ----------------------------------------------------------------------
+# KV half: int8 pages + scales through the serving engine
+# ----------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def lm():
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    return TransformerLM(vocab=VOCAB, d_model=16, n_heads=2, d_ff=32,
+                         n_layers=2, max_len=64)
+
+
+@pytest.fixture(scope="module")
+def lm_params(lm):
+    return lm.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def make_engine(lm, lm_params, **over):
+    from chainermn_tpu.serving import EngineConfig, InferenceEngine
+
+    cfg = dict(block_size=4, n_blocks=64, max_len=64, max_batch=4)
+    cfg.update(over)
+    return InferenceEngine(lm, lm_params, EngineConfig(**cfg))
+
+
+def prompts_for(n, rng_seed=7, lo=3, hi=13):
+    rng = np.random.default_rng(rng_seed)
+    return [
+        [int(t) for t in rng.integers(0, VOCAB, size=int(l))]
+        for l in rng.integers(lo, hi, size=n)
+    ]
+
+
+def test_int8_kv_cache_carries_scale_leaves(lm, lm_params):
+    eng = make_engine(lm, lm_params, kv_dtype="int8")
+    assert eng.kv_dtype == "int8"
+    eng.kv.allocate("s", 6)
+    eng.prefill(prompts_for(1)[0][:6], "s")
+    dts = {jnp.dtype(l.dtype) for l in jax.tree.leaves(eng._cache)}
+    assert jnp.dtype(jnp.int8) in dts       # quantized pages
+    assert jnp.dtype(jnp.float32) in dts    # per-token-per-head scales
+    st = eng.stats()
+    assert st["kv_dtype"] == "int8"
+    assert st["kv_quant_err"] > 0.0         # sown in-jit, folded on host
+
+    # default engine: no int8 leaves, no new stats keys (shape pinned)
+    ref = make_engine(lm, lm_params)
+    assert ref.kv_dtype is None
+    ref_dts = {jnp.dtype(l.dtype) for l in jax.tree.leaves(ref._cache)}
+    assert jnp.dtype(jnp.int8) not in ref_dts
+    assert "kv_dtype" not in ref.stats()
+    assert "kv_quant_err" not in ref.stats()
+
+
+def test_int8_kv_greedy_streams_match_full_precision(lm, lm_params):
+    """The acceptance surface: int8-KV decode streams equal the
+    full-precision engine's token-for-token on this geometry."""
+    ref = make_engine(lm, lm_params)
+    eng = make_engine(lm, lm_params, kv_dtype="int8")
+    for p in prompts_for(4, rng_seed=3):
+        assert eng.generate(p, 8) == ref.generate(p, 8), p
+
+
+def test_int8_kv_sampled_streams_match_full_precision(lm, lm_params):
+    from chainermn_tpu.serving import SamplingParams
+
+    sp = SamplingParams(temperature=0.8, top_k=8, seed=5)
+    ref = make_engine(lm, lm_params)
+    eng = make_engine(lm, lm_params, kv_dtype="int8")
+    for p in prompts_for(3, rng_seed=9):
+        assert eng.generate(p, 8, sampling=sp) == \
+            ref.generate(p, 8, sampling=sp), p
+
+
+def test_int8_kv_defragment_mid_stream_keeps_stream(lm, lm_params):
+    """Compaction moves int8 pages AND their scale pages; the stream
+    must equal the same engine's uninterrupted decode."""
+    eng = make_engine(lm, lm_params, kv_dtype="int8")
+    prompt = prompts_for(1)[0]
+    want = eng.generate(prompt, 5)
+
+    sid = "s"
+    eng.kv.allocate(sid, len(prompt))
+    logits = eng.prefill(prompt, sid)
+    got, cur = [], len(prompt)
+    for step in range(5):
+        nxt = int(np.argmax(logits))
+        got.append(nxt)
+        if step == 4:
+            break
+        eng.kv.extend(sid, cur + 1)
+        if step == 1:
+            eng.kv.allocate("lo", eng.kv.block_size)
+            eng.kv.allocate("hi", eng.kv.block_size)
+            eng.kv.free("lo")
+            assert eng.defragment() > 0
+            eng.kv.free("hi")
+        logits = eng.decode([nxt], [sid], [cur])[0]
+        cur += 1
+    eng.kv.free(sid)
+    eng.kv.assert_consistent()
+    assert got == want
+
+
+def test_int8_kv_migration_carries_scales(lm, lm_params):
+    """Snapshot/restore to a differently-sized pool: the leaf-generic
+    wire format must move the f32 scale pages with the int8 payload."""
+    from chainermn_tpu.serving import SamplingParams
+    from chainermn_tpu.serving.cluster import (
+        extract_sequence,
+        restore_sequence,
+    )
+
+    prompt = prompts_for(1, rng_seed=5)[0]
+    src = make_engine(lm, lm_params, kv_dtype="int8")
+    want = src.generate(prompt, 8)
+
+    dst = make_engine(lm, lm_params, kv_dtype="int8", n_blocks=32)
+    sp = SamplingParams()
+    src.kv.allocate("s", len(prompt))
+    logits = src.prefill(prompt, "s")
+    toks = [src.sample(logits, sp, len(prompt))]
+    cur = len(prompt)
+    for _ in range(3):
+        src.kv.extend("s", cur + 1)
+        logits = src.decode([toks[-1]], ["s"], [cur])[0]
+        cur += 1
+        toks.append(src.sample(logits, sp, cur))
+
+    snap = extract_sequence(src, "s", context=prompt + toks[:-1])
+    # both dtypes ride the wire: int8 pages and their f32 scales
+    leaf_dts = {str(p.dtype) for p in snap.pages}
+    assert "int8" in leaf_dts and "float32" in leaf_dts
+    src.kv.free("s")
+
+    restore_sequence(dst, snap, "t")
+    dst.kv.assert_consistent()
+    while len(toks) < 8:
+        dst.kv.extend("t", cur + 1)
+        logits = dst.decode([toks[-1]], ["t"], [cur])[0]
+        cur += 1
+        toks.append(dst.sample(logits, sp, cur))
+    assert toks == want
+
+
+def test_int8_kv_prefix_cow_split_keeps_streams(lm, lm_params):
+    """Shared-prefix traffic on the int8 engine: prefix reuse and the
+    CoW split both copy scale pages with payload pages — every stream
+    equals the same engine's sequential decode."""
+    from chainermn_tpu.serving import ContinuousBatchingScheduler, Request
+
+    # duplicate-prefix traffic: a shared 8-token (2 full pages) head,
+    # one prompt IS exactly the head (the full-hit CoW-rewind path),
+    # and more prompts than max_batch so a second admission wave hits
+    # the prefix registered by the first.
+    rng = np.random.default_rng(11)
+    shared = [int(t) for t in rng.integers(0, VOCAB, size=8)]
+    prompts = []
+    for i in range(6):
+        tail = [int(t) for t in rng.integers(0, VOCAB, size=3 + i % 3)]
+        prompts.append(shared + tail if i % 2 == 0 else tail)
+    prompts.append(list(shared))
+
+    seq = make_engine(lm, lm_params, kv_dtype="int8")
+    want = [seq.generate(p, 8) for p in prompts]
+
+    eng = make_engine(lm, lm_params, kv_dtype="int8")
+    sched = ContinuousBatchingScheduler(eng)
+    for i, p in enumerate(prompts):
+        sched.add_request(Request(request_id=i, prompt=list(p),
+                                  max_new_tokens=8))
+    res = sched.run_to_completion()
+    for i in range(len(prompts)):
+        assert res[i].state.value == "finished", res[i].error
+        assert res[i].generated == want[i], f"request {i} diverged"
+    st = eng.stats()
+    assert st["cow_splits"] >= 1 and st["tokens_prefix_cached"] > 0
+    eng.kv.assert_consistent()
+
+
+def test_kv_dtype_env_and_config_resolution(lm, lm_params, monkeypatch):
+    monkeypatch.delenv(quant.ENV_KV_DTYPE, raising=False)
+    assert make_engine(lm, lm_params).kv_dtype is None
+
+    monkeypatch.setenv(quant.ENV_KV_DTYPE, "int8")
+    assert make_engine(lm, lm_params).kv_dtype == "int8"
+    # explicit config wins over the env — including explicit OFF
+    assert make_engine(lm, lm_params, kv_dtype="none").kv_dtype is None
+
+    from chainermn_tpu.serving import EngineConfig, InferenceEngine
+    with pytest.raises(ValueError, match="kv_dtype"):
+        InferenceEngine(lm, lm_params, EngineConfig(
+            block_size=4, n_blocks=64, max_len=64, max_batch=4,
+            kv_dtype="int4",
+        ))

@@ -1,0 +1,146 @@
+"""Golden-file regression test for the QUANTIZED allreduce census.
+
+Pins the jaxpr-level collective lowering of ``allreduce_grad`` under
+``comm_dtype="int8"`` over the same canonical 64-leaf tree as
+``test_hlo_census_golden.py``: the scaled wire must still emit <= 2
+reduction collectives per dtype bucket (the amax agreement rides a
+``pmax``, which is NOT a payload reduction and must not inflate the
+census), and the reduction payload itself must narrow to one byte per
+element.  A refactor that silently de-fuses the quantized path into
+per-leaf reductions, or that starts counting the scale exchange as
+payload, fails here with a structural diff.
+
+Regenerate after an INTENDED lowering change::
+
+    python tests/test_quant_census_golden.py --regen
+"""
+
+import json
+import os
+
+GOLDEN_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "golden", "allreduce_census_64leaf_int8.json",
+)
+BASELINE_GOLDEN_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "golden", "allreduce_census_64leaf.json",
+)
+
+#: fixed scenario — matches tests/test_hlo_census_golden.py so the
+#: quantized census is directly comparable to the full-precision one.
+MESH_SHAPE = (2, 4)
+N_LEAVES = 64
+TOTAL_BYTES = 8 * 1024 * 1024
+BUCKET_BYTES = 256 * 1024
+
+COMMUNICATORS = ["naive", "flat", "xla_ici", "hierarchical",
+                 "two_dimensional"]
+
+
+def compute_census() -> dict:
+    import jax
+
+    from chainermn_tpu.communicators import build_mesh, create_communicator
+    from chainermn_tpu.communicators.packing import synthetic_grad_tree
+    from chainermn_tpu.observability import audit_allreduce_tree
+
+    devs = jax.devices()[: MESH_SHAPE[0] * MESH_SHAPE[1]]
+    mesh = build_mesh(
+        inter_size=MESH_SHAPE[0], intra_size=MESH_SHAPE[1], devices=devs
+    )
+    tree = synthetic_grad_tree(N_LEAVES, TOTAL_BYTES)
+    out = {
+        "mesh": list(MESH_SHAPE),
+        "n_leaves": N_LEAVES,
+        "total_bytes": TOTAL_BYTES,
+        "bucket_bytes": BUCKET_BYTES,
+        "comm_dtype": "int8",
+        "communicators": {},
+    }
+    for name in COMMUNICATORS:
+        comm = create_communicator(
+            name, mesh=mesh, bucket_bytes=BUCKET_BYTES, overlap=False,
+            comm_dtype="int8",
+        )
+        audit = audit_allreduce_tree(comm, tree)
+        out["communicators"][name] = {
+            "hlo_collectives": audit.census(),
+            "reduction_collectives": audit.reduction_collectives(),
+            "per_axis_operand_bytes": dict(
+                sorted(audit.bytes_per_axis.items())
+            ),
+            "op_bytes": {k: list(v) for k, v in
+                         sorted(audit.op_bytes.items())},
+        }
+    return out
+
+
+def test_quantized_census_matches_golden():
+    with open(GOLDEN_PATH) as f:
+        golden = json.load(f)
+    current = compute_census()
+    for name in COMMUNICATORS:
+        assert current["communicators"][name] == \
+            golden["communicators"][name], (
+                f"{name} quantized collective census drifted from the "
+                f"golden file — if the lowering change is intended, "
+                f"regenerate with: python {__file__} --regen"
+            )
+    assert current == golden
+
+
+def test_quantized_golden_internal_consistency():
+    """The pinned numbers must satisfy the acceptance bounds: <= 2
+    reduction collectives per bucket (scale exchange excluded), and the
+    reduction payload narrowed vs the full-precision golden."""
+    with open(GOLDEN_PATH) as f:
+        golden = json.load(f)
+    with open(BASELINE_GOLDEN_PATH) as f:
+        baseline = json.load(f)
+    from chainermn_tpu.communicators.packing import (
+        GradPacker,
+        synthetic_grad_tree,
+    )
+
+    tree = synthetic_grad_tree(N_LEAVES, TOTAL_BYTES)
+    plan = GradPacker.for_tree(tree, bucket_bytes=BUCKET_BYTES)
+    assert plan.n_leaves == N_LEAVES
+    for name, entry in golden["communicators"].items():
+        assert entry["reduction_collectives"] <= 2 * plan.n_buckets, name
+        # quantizing must not change HOW MANY payload reductions run —
+        # only what rides them (int8 instead of fp32)...
+        base = baseline["communicators"][name]["bucketed"]
+        assert entry["reduction_collectives"] == \
+            base["reduction_collectives"], name
+        # ...so the per-axis reduction traffic shrinks.  Not a strict
+        # 4x: the fp32 amax scalars and any fp32 residual ops ride the
+        # same axes, but the narrowing must dominate.
+        for axis, b in entry["per_axis_operand_bytes"].items():
+            assert b < base["per_axis_operand_bytes"][axis], (name, axis)
+
+
+if __name__ == "__main__":
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--regen", action="store_true",
+                    help="rewrite the golden file from the current lowering")
+    args = ap.parse_args()
+    if not args.regen:
+        ap.error("run under pytest, or pass --regen to regenerate")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+    census = compute_census()
+    with open(GOLDEN_PATH, "w") as f:
+        json.dump(census, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {GOLDEN_PATH}", file=sys.stderr)
