@@ -1156,7 +1156,26 @@ def main(argv=None):
                          "(compile events, instrumented-step spans, the "
                          "final result row); summarize with `python -m "
                          "chainermn_tpu.tools.obs summarize PATH`")
+    ap.add_argument("--chaos", default=None, metavar="SCHEDULE",
+                    help="fault-injection soak: run the elastic "
+                         "supervisor over a deterministic training "
+                         "worker twice — once clean, once under this "
+                         "chaos schedule (docs/fault_tolerance.md "
+                         "grammar, e.g. 'kill:rank=1:step=5') — and "
+                         "report restarts/preemptions/resume generation "
+                         "plus whether the faulted run's final params "
+                         "digest matches the uninterrupted oracle; "
+                         "alone it is its own bench mode, with "
+                         "--only/--serve it rides along as a \"chaos\" "
+                         "section")
+    ap.add_argument("--chaos-nproc", type=int, default=2,
+                    help="world size for the --chaos soak")
     args = ap.parse_args(argv)
+    if args.chaos and not args.serve and args.only is None:
+        # Chaos-only mode: pure process orchestration, no device bench
+        # (and no backend init in THIS process).
+        print(json.dumps({"chaos": _chaos_soak(args)}))
+        return
     if not args.no_overlap:
         # Seed the latency-hiding / async-collective XLA flags before the
         # first device touch initializes the backend (no-op off-TPU).
@@ -1193,6 +1212,8 @@ def main(argv=None):
         out["lm"] = bench_lm(comm, args)
         out["allreduce_static_bytes_per_leg"] = _static_allreduce_table()
         out["allreduce_tree"] = _allreduce_tree_table()
+    if args.chaos:
+        out["chaos"] = _chaos_soak(args)
     if recorder is not None:
         recorder.step()  # flush buffered compile events and step spans
         if reporter is not None:
@@ -1200,6 +1221,67 @@ def main(argv=None):
         recorder.record("bench_result", result=out)
     telemetry.close()
     print(json.dumps(out))
+
+
+def _chaos_soak(args):
+    """Deterministic fault-injection soak (``--chaos SCHEDULE``): the
+    elastic supervisor drives the soak training worker in a CPU
+    subprocess world, once uninterrupted (the oracle) and once under the
+    schedule.  The pinned evidence is the supervisor report pair —
+    restarts/preemptions/resume generation under fault, and whether the
+    faulted run's final params digest is bit-identical to the oracle's
+    (it must be whenever the schedule keeps the world size fixed)."""
+    import subprocess
+    import sys
+    import tempfile
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    root = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    worker = os.path.join(root, "tests", "_elastic_train_worker.py")
+
+    def run(tag, *extra):
+        d = tempfile.mkdtemp(prefix=f"bench_chaos_{tag}_")
+        cmd = [
+            sys.executable, "-m", "chainermn_tpu.tools.elastic",
+            "--nproc", str(args.chaos_nproc),
+            "--workdir", os.path.join(d, "work"),
+            "--hb-timeout", "60", "--grace", "10", *extra, "--",
+            sys.executable, worker, "--ckpt", os.path.join(d, "ckpt"),
+        ]
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=600,
+                env=env,
+            )
+        except Exception as e:  # pragma: no cover - environment-specific
+            return {"error": f"{type(e).__name__}: {e}"}
+        lines = [ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("ELASTIC_REPORT ")]
+        if proc.returncode != 0 or not lines:
+            return {
+                "error": (proc.stdout + proc.stderr).strip()[-800:]
+                or f"exit {proc.returncode}",
+            }
+        return json.loads(lines[-1].split(" ", 1)[1])
+
+    oracle = run("oracle")
+    chaos = run("chaos", "--chaos", args.chaos)
+    out = {
+        "schedule": args.chaos,
+        "nproc": args.chaos_nproc,
+        "oracle": oracle,
+        "chaos": chaos,
+    }
+    if "error" not in oracle and "error" not in chaos:
+        out["digest_match"] = bool(
+            chaos.get("params_digest")
+            and chaos["params_digest"] == oracle.get("params_digest")
+        )
+    return out
 
 
 def _static_allreduce_table():

@@ -39,7 +39,7 @@ def __getattr__(name):
         from chainermn_tpu import links
 
         return getattr(links, name)
-    if name in ("analysis", "functions", "observability"):
+    if name in ("analysis", "functions", "observability", "elastic"):
         import importlib
 
         return importlib.import_module(f"chainermn_tpu.{name}")

@@ -1099,12 +1099,6 @@ class CommunicatorBase:
         instead of blocking forever.
 
         Payloads travel at their exact size — no pad-to-max."""
-        if timeout_ms is not None and root is None:
-            raise ValueError(
-                "gather_obj: timeout_ms is only supported with root=... "
-                "(the point-to-root path); the allgather path has no "
-                "bounded-wait implementation and would silently ignore it"
-            )
         if self.size == 1:
             return [obj]
         if root is not None:
@@ -1113,7 +1107,13 @@ class CommunicatorBase:
             self._require_kv("gather_obj(root=...)")
             return self._obj_plane.gather(obj, root, timeout_ms=timeout_ms)
         if kvtransport.available():
-            return self._obj_plane.allgather(obj)
+            return self._obj_plane.allgather(obj, timeout_ms=timeout_ms)
+        if timeout_ms is not None:
+            raise ValueError(
+                "gather_obj: timeout_ms with root=None needs the KV "
+                "object plane; the process_allgather fallback has no "
+                "bounded-wait implementation and would silently ignore it"
+            )
         self._require_subgroup_kv("gather_obj")
         from jax.experimental import multihost_utils
 
@@ -1151,15 +1151,35 @@ class CommunicatorBase:
 
     _barrier_seq = 0  # class-level: every process advances it identically
 
-    def barrier(self):
+    def barrier(self, timeout_s: float | None = None):
+        """``timeout_s`` (or env ``CHAINERMN_TPU_BARRIER_TIMEOUT_S``,
+        which the elastic supervisor sets for every rank it spawns)
+        bounds the wait: a peer that died mid-job raises
+        ``TimeoutError`` here instead of stalling the survivor forever
+        — the except hook then turns that into a loud, fast exit the
+        supervisor can act on.  The env knob must be set identically on
+        every rank (it routes the barrier over the object plane, and
+        mixed routes would deadlock)."""
         if self.size <= 1:
             return
+        if timeout_s is None:
+            t = os.environ.get("CHAINERMN_TPU_BARRIER_TIMEOUT_S")
+            timeout_s = float(t) if t else None
         if self._hp_members is not None:
             # Subgroup barrier: must involve ONLY the members (a world
             # barrier would deadlock against other colors).  An obj-plane
             # allgather of a token has exactly MPI_Barrier's completion
             # semantics: no member returns before every member arrived.
-            self.allgather_obj(None)
+            self.gather_obj(
+                None,
+                timeout_ms=None if timeout_s is None
+                else int(timeout_s * 1000),
+            )
+            return
+        if timeout_s is not None and kvtransport.available():
+            self._obj_plane.allgather(
+                None, timeout_ms=int(timeout_s * 1000)
+            )
             return
         from jax.experimental import multihost_utils
 

@@ -390,7 +390,14 @@ def ack_and_collect(key: str, n_chunks: int, n_readers: int) -> None:
     ack (atomic increment) deletes the data; earlier readers return
     immediately.  Safe because readers only ack *after* consuming."""
     c = client()
-    if int(c.key_value_increment(f"{key}/ack", 1)) >= n_readers:
+    incr = getattr(c, "key_value_increment", None)
+    if incr is None:
+        # jaxlib builds without the atomic counter offer no safe
+        # last-reader election: leave the payload for the coordinator
+        # to reap at job end (keys are sequence-numbered, never
+        # reused, so correctness is unaffected — only KV residency).
+        return
+    if int(incr(f"{key}/ack", 1)) >= n_readers:
         delete(key, n_chunks)
         c.key_value_delete(f"{key}/ack")
 
@@ -973,7 +980,12 @@ class ObjectPlane:
         self._commit(slot)
         return obj
 
-    def allgather(self, obj) -> list:
+    def allgather(self, obj, *, timeout_ms: int | None = None) -> list:
+        """``timeout_ms`` bounds the wait on EACH member's payload so a
+        dead peer surfaces as ``TimeoutError`` instead of a hang (the
+        elastic supervisor's bounded-teardown contract rides this: a
+        timed-out collective leaves the slot uncommitted, so the caller
+        must treat it as fatal and die loudly, not retry)."""
         self._ensure_validated()
         slot = ("gather",)
         base = self._key("gather", self._peek(slot))
@@ -983,7 +995,7 @@ class ObjectPlane:
             if g == self.rank:
                 out.append(obj)
                 continue
-            got, n = get_payload(f"{base}/{g}")
+            got, n = get_payload(f"{base}/{g}", timeout_ms=timeout_ms)
             out.append(got)
             ack_and_collect(f"{base}/{g}", n, self.size - 1)
         self._commit(slot)

@@ -1,0 +1,50 @@
+"""Elastic training: supervisor, fault-injection (chaos) harness, and
+the worker-side runtime (see docs/fault_tolerance.md).
+
+Launch::
+
+    python -m chainermn_tpu.tools.elastic --nproc 2 -- \\
+        python examples/mnist/train_mnist.py --communicator naive \\
+        --elastic --checkpoint-dir ckpt
+
+Training scripts opt in with :func:`init_from_env` (a no-op outside a
+supervised run) and one :meth:`ElasticContext.beat` per step.
+"""
+
+from chainermn_tpu.elastic.chaos import (  # noqa: F401
+    ChaosEngine,
+    ChaosSchedule,
+    Fault,
+)
+from chainermn_tpu.elastic.heartbeat import (  # noqa: F401
+    FileBeat,
+    HeartbeatMonitor,
+    read_beat,
+)
+from chainermn_tpu.elastic.supervisor import (  # noqa: F401
+    EXIT_PREEMPTED,
+    ElasticSupervisor,
+    SupervisorConfig,
+    run_supervised,
+)
+from chainermn_tpu.elastic.worker import (  # noqa: F401
+    ElasticContext,
+    active,
+    init_from_env,
+)
+
+__all__ = [
+    "ChaosEngine",
+    "ChaosSchedule",
+    "Fault",
+    "FileBeat",
+    "HeartbeatMonitor",
+    "read_beat",
+    "EXIT_PREEMPTED",
+    "ElasticSupervisor",
+    "SupervisorConfig",
+    "run_supervised",
+    "ElasticContext",
+    "active",
+    "init_from_env",
+]

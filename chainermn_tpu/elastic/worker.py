@@ -1,0 +1,236 @@
+"""Worker-side elastic runtime — the rank half of the supervisor
+contract.
+
+A supervised rank receives its identity through env
+(``CHAINERMN_TPU_ELASTIC_*``); :func:`init_from_env` reads it, joins
+the ``jax.distributed`` world with bounded retries + backoff (a
+half-started coordinator must surface as an error, never a hang),
+installs the crash barrier (whose postmortem row the supervisor reads)
+and a SIGTERM handler that records preemption instead of dying
+mid-collective, and arms the chaos engine when a fault schedule is
+present.
+
+Training loops drive three methods:
+
+* :meth:`ElasticContext.beat` once per step — fires due chaos faults,
+  then touches the heartbeat file the supervisor watches;
+* :meth:`ElasticContext.check_preemption` — a host-plane allreduce of
+  the SIGTERM flag, so ONE preempted rank moves ALL ranks into the
+  grace-window checkpoint together (a lone rank cannot checkpoint: the
+  save barrier needs everyone);
+* :meth:`ElasticContext.exit_preempted` — flush and exit with
+  ``EXIT_PREEMPTED`` so the supervisor counts a preemption, not a
+  crash.
+
+:meth:`ElasticContext.reshard` is the rescale half: resolve a named
+``ShardingPlan`` against the *current* mesh and re-place restored
+params/moments through it — N→M restart is ``plan.resolve`` on a
+different mesh, no conversion tooling.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+from typing import Optional
+
+from chainermn_tpu.elastic import chaos as chaos_mod
+from chainermn_tpu.elastic.heartbeat import FileBeat
+from chainermn_tpu.elastic.supervisor import EXIT_PREEMPTED
+
+ENV_ACTIVE = "CHAINERMN_TPU_ELASTIC"
+
+
+def active() -> bool:
+    """True when this process runs under the elastic supervisor."""
+    return os.environ.get(ENV_ACTIVE) == "1"
+
+
+class ElasticContext:
+    def __init__(self, rank: int, nproc: int, coordinator: str,
+                 incarnation: int, heartbeat: Optional[FileBeat],
+                 chaos_engine):
+        self.rank = rank
+        self.nproc = nproc
+        self.coordinator = coordinator
+        self.incarnation = incarnation
+        self.heartbeat = heartbeat
+        self.chaos = chaos_engine
+        self._preempted = False
+
+    # -- per-step ------------------------------------------------------
+    def beat(self, step: int) -> None:
+        from chainermn_tpu import global_except_hook
+
+        global_except_hook.set_current_step(step)
+        if self.chaos is not None:
+            self.chaos.on_step(step)
+        if self.heartbeat is not None:
+            self.heartbeat.beat(step)
+
+    @property
+    def preempted(self) -> bool:
+        return self._preempted
+
+    def check_preemption(self, comm) -> bool:
+        """Did ANY rank receive SIGTERM?  Collective: every rank must
+        call it at the same step so the grace-window checkpoint is
+        coordinated."""
+        if comm is None or comm.size <= 1:
+            return self._preempted
+        return bool(comm.allreduce_obj(int(self._preempted)))
+
+    def exit_preempted(self) -> "None":
+        """Exit with the preemption code.  ``os._exit`` on purpose: all
+        ranks leave together right after a blocking checkpoint save, and
+        no atexit teardown (distributed shutdown barriers included) may
+        outlive the supervisor's grace window."""
+        sys.stdout.flush()
+        sys.stderr.flush()
+        if self.rank == 0 and self.nproc > 1:
+            # The coordination service lives in rank 0: leaving first
+            # hard-kills every peer's distributed client mid-exit.  Give
+            # them a head start; the supervisor treats any stragglers'
+            # deaths as preemption collateral regardless.
+            time.sleep(1.0)
+        os._exit(EXIT_PREEMPTED)
+
+    # -- checkpoint integration ---------------------------------------
+    def attach_checkpointer(self, ckpt) -> None:
+        """Arm checkpoint-path chaos faults (corrupt/torn/slow) on this
+        rank's checkpointer.  No-op without a schedule."""
+        if self.chaos is not None:
+            self.chaos.wrap_checkpointer(ckpt)
+
+    # -- rescale -------------------------------------------------------
+    def reshard(self, params, opt_state, comm, plan: str = "dp",
+                place: bool = True):
+        """Re-place restored state for the CURRENT mesh through a named
+        sharding plan.  Returns ``(params, opt_state, validation)`` —
+        the :class:`~chainermn_tpu.sharding.PlanValidation` is the
+        machine-checkable proof the resharded layout is legal on this
+        mesh (every leaf matched, no axis conflicts).
+
+        ``place=False`` validates the plan against the new mesh without
+        committing device placement — for host-plane training loops (or
+        backends without cross-process device collectives) that still
+        want the N→M layout proof."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from chainermn_tpu.sharding import get_plan, validate
+
+        p = get_plan(plan)
+        report = validate(p, params, mesh=comm.mesh)
+        if not report.ok:
+            raise ValueError(
+                "elastic reshard: plan does not cover the restored "
+                "state on the new mesh:\n" + report.render()
+            )
+        if not place:
+            return params, opt_state, report
+
+        def place_tree(tree, specs):
+            shardings = jax.tree.map(
+                lambda s: NamedSharding(comm.mesh, s), specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+
+            def put(x, sh):
+                import numpy as np
+
+                arr = np.asarray(x)
+                return jax.make_array_from_callback(
+                    arr.shape, sh, lambda idx: arr[idx]
+                )
+
+            return jax.tree.map(put, tree, shardings)
+
+        params = place_tree(params, p.resolve(params))
+        if opt_state is not None:
+            opt_state = place_tree(opt_state, p.resolve_moments(opt_state))
+        return params, opt_state, report
+
+
+def init_from_env(install_hooks: bool = True) -> Optional[ElasticContext]:
+    """Join the supervised world, or return None when not supervised
+    (so ``--elastic`` examples degrade to plain runs).
+
+    Must run BEFORE the jax backend initializes (i.e. before
+    ``create_communicator`` / any ``jax.devices()`` call)."""
+    if not active():
+        return None
+    rank = int(os.environ["CHAINERMN_TPU_ELASTIC_RANK"])
+    nproc = int(os.environ["CHAINERMN_TPU_ELASTIC_NPROC"])
+    coord = os.environ["CHAINERMN_TPU_ELASTIC_COORD"]
+    incarnation = int(
+        os.environ.get("CHAINERMN_TPU_ELASTIC_INCARNATION", "0")
+    )
+    init_timeout = float(
+        os.environ.get("CHAINERMN_TPU_ELASTIC_INIT_TIMEOUT_S", "120")
+    )
+
+    hb = None
+    hb_path = os.environ.get("CHAINERMN_TPU_ELASTIC_HB_FILE")
+    if hb_path:
+        hb = FileBeat(hb_path)
+    engine = chaos_mod.engine_from_env(rank, incarnation, heartbeat=hb)
+    ctx = ElasticContext(rank, nproc, coord, incarnation, hb, engine)
+
+    if install_hooks:
+        from chainermn_tpu import global_except_hook
+
+        global_except_hook.add_hook()
+
+    if nproc > 1:
+        _distributed_init(coord, nproc, rank, init_timeout)
+
+    if install_hooks:
+        def on_term(signum, frame):
+            # Record only: the training loop propagates the flag through
+            # check_preemption and does the coordinated checkpoint at a
+            # step boundary — never from inside a signal handler.
+            ctx._preempted = True
+
+        # AFTER distributed init: jax.distributed installs its own
+        # SIGTERM handler there, which would otherwise clobber ours and
+        # turn every preemption into an uncoordinated shutdown.
+        signal.signal(signal.SIGTERM, on_term)
+    if hb is not None:
+        hb.beat(-1)  # prove liveness before the first training step
+    return ctx
+
+
+def _distributed_init(coord: str, nproc: int, rank: int,
+                      timeout_s: float) -> None:
+    """``jax.distributed.initialize`` with bounded retries + backoff —
+    a respawned incarnation can race the previous coordinator's port
+    release, and that must cost a retry, not a hang."""
+    import jax
+
+    kwargs = {}
+    try:
+        import inspect
+
+        if "initialization_timeout" in inspect.signature(
+            jax.distributed.initialize
+        ).parameters:
+            kwargs["initialization_timeout"] = max(10, int(timeout_s))
+    except (TypeError, ValueError):
+        pass
+    delay, attempts = 0.2, 3
+    for attempt in range(attempts + 1):
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coord, num_processes=nproc,
+                process_id=rank, **kwargs,
+            )
+            break
+        except Exception:
+            if attempt >= attempts:
+                raise
+            time.sleep(delay)
+            delay = min(delay * 2, 5.0)
+    jax.devices()  # materialize the world before any collective

@@ -359,11 +359,16 @@ class MultiNodeCheckpointer:
         comm: CommunicatorBase,
         path: str = ".",
         keep: int = 2,
+        keep_last_n: Optional[int] = None,
     ):
         self.name = name
         self.comm = comm
         self.dir = os.path.join(path, name)
-        self.keep = keep
+        # ``keep_last_n`` is the retention knob long soaks tune: it
+        # bounds BOTH live consistent generations (same rotation as
+        # ``keep``, which it overrides when given) and retained
+        # quarantined generations.
+        self.keep = keep if keep_last_n is None else int(keep_last_n)
         os.makedirs(self.dir, exist_ok=True)
         self._pending: Optional[threading.Thread] = None
         self._pending_error: Optional[BaseException] = None
@@ -408,7 +413,11 @@ class MultiNodeCheckpointer:
             _write_snapshot(tmp, host_state)
             os.replace(tmp, self._snap(iteration, rank))
             with open(self._marker(iteration, rank), "w") as f:
-                f.write("ok")
+                # The marker records the world size that wrote this
+                # generation: consistency is "every SAVE-TIME rank
+                # committed", so a rescaled relaunch (different
+                # comm.size) can still recognize and resume it.
+                f.write(f"ok {self.comm.size}")
 
         if block:
             write()
@@ -462,12 +471,65 @@ class MultiNodeCheckpointer:
             int(m.group(1)) for m in map(pat.match, names) if m
         )
 
+    def _marker_world(self, it: int, names=None) -> Optional[int]:
+        """World size recorded in generation ``it``'s markers, or None
+        for legacy markers (pre-world-stamp: plain "ok")."""
+        if names is None:
+            names = os.listdir(self.dir)
+        pat = re.compile(rf"done_iter_{it}\.rank\d+$")
+        for fn in sorted(n for n in names if pat.match(n)):
+            try:
+                with open(os.path.join(self.dir, fn)) as f:
+                    parts = f.read().split()
+                if len(parts) >= 2:
+                    return int(parts[1])
+            except (OSError, ValueError):
+                continue
+        return None
+
     def _consistent_generations(self, names=None):
-        return sorted(
-            it
-            for it, cnt in self._generations(names).items()
-            if cnt >= self.comm.size
+        """Generations every save-time rank committed.  The marker's
+        recorded world size (not the CURRENT comm.size) is the quorum,
+        so an elastic N→M relaunch resumes generations the old world
+        wrote; legacy markers fall back to the current-size rule."""
+        if names is None:
+            names = os.listdir(self.dir)
+        out = []
+        for it, cnt in self._generations(names).items():
+            world = self._marker_world(it, names)
+            if cnt >= (world if world is not None else self.comm.size):
+                out.append(it)
+        return sorted(out)
+
+    def _quarantined_generations(self, names=None):
+        if names is None:
+            names = os.listdir(self.dir)
+        pat = re.compile(
+            r"(?:snapshot|done)_iter_(\d+)\.rank\d+\.quarantined$"
         )
+        return sorted({
+            int(m.group(1)) for m in map(pat.match, names) if m
+        })
+
+    def _quarantine(self, it: int) -> None:
+        """Rename generation ``it``'s files to ``*.quarantined`` so it
+        drops out of ``_generations`` permanently — rejected snapshots
+        are kept for forensics but never re-verified on later loads.
+        Every rank runs this after the failed vote; file ownership is
+        split by ``saved_rank % comm.size`` so concurrent renames never
+        collide and orphan ranks of a shrunken world are covered."""
+        pat = re.compile(
+            rf"(?:snapshot|done)_iter_{it}\.rank(\d+)(?:\.tmp)?$"
+        )
+        for fn in os.listdir(self.dir):
+            m = pat.match(fn)
+            if not m or int(m.group(1)) % self.comm.size != self.comm.rank:
+                continue
+            src = os.path.join(self.dir, fn)
+            try:
+                os.replace(src, src + ".quarantined")
+            except OSError:
+                pass
 
     def _cleanup(self, ranks=None):
         """Rotate old generations.
@@ -480,39 +542,64 @@ class MultiNodeCheckpointer:
         after the barrier) or just our own (async mode, where deleting a
         straggler's files could race its ``maybe_load``; each rank reads
         only its own snapshot, so own-file deletion can never break a
-        concurrent load on another rank).
+        concurrent load on another rank).  File ownership is
+        ``saved_rank % comm.size``, NOT identity: after a rescale the
+        dead ranks' leftovers must still have an owner, or a shrunken
+        world would leak them forever.
+
+        Quarantined generations rotate on the same ``keep`` budget but
+        without tombstones (nothing ever loads them, so deleting them
+        can't race anything).
         """
         # One directory snapshot serves every check below (shared/network
         # storage: listings are not free), updated locally as we write
         # tombstones and delete files.
         names = set(os.listdir(self.dir))
         done = self._consistent_generations(names)
-        if ranks is None:
-            ranks = range(self.comm.size)
         for it in done[: -self.keep] if len(done) > self.keep else []:
             with open(self._tomb(it), "w") as f:
                 f.write("rotated")
             names.add(os.path.basename(self._tomb(it)))
-        for it in self._tombstoned(names):
-            for rank in ranks:
-                snap = self._snap(it, rank)
-                for p in (snap, snap + ".tmp", self._marker(it, rank)):
-                    try:
-                        os.remove(p)
-                        names.discard(os.path.basename(p))
-                    except OSError:
-                        pass
-            # Drop the tombstone once every rank's files — including any
-            # crash-orphaned .tmp — are gone (any rank may observe this;
-            # double-removal is swallowed).
+
+        def mine(saved_rank: int) -> bool:
+            return ranks is None or \
+                saved_rank % self.comm.size in ranks
+
+        pat = re.compile(
+            r"(?:snapshot|done)_iter_(\d+)\.rank(\d+)"
+            r"(?:\.tmp)?(\.quarantined)?$"
+        )
+        tombstoned = set(self._tombstoned(names))
+        quarantined = self._quarantined_generations(names)
+        stale_q = set(
+            quarantined[: -self.keep] if len(quarantined) > self.keep
+            else []
+        )
+        for fn in sorted(names):
+            m = pat.match(fn)
+            if not m:
+                continue
+            it, saved_rank = int(m.group(1)), int(m.group(2))
+            if m.group(3):
+                if it not in stale_q:
+                    continue
+            elif it not in tombstoned:
+                continue
+            if not mine(saved_rank):
+                continue
+            try:
+                os.remove(os.path.join(self.dir, fn))
+                names.discard(fn)
+            except OSError:
+                pass
+        # Drop a tombstone once every live (non-quarantined) file of its
+        # generation — including any crash-orphaned .tmp — is gone (any
+        # rank may observe this; double-removal is swallowed).
+        for it in tombstoned:
             gone = not any(
-                os.path.basename(p) in names
-                for rank in range(self.comm.size)
-                for p in (
-                    self._snap(it, rank),
-                    self._snap(it, rank) + ".tmp",
-                    self._marker(it, rank),
-                )
+                (m := pat.match(fn)) is not None
+                and int(m.group(1)) == it and not m.group(3)
+                for fn in names
             )
             if gone:
                 try:
@@ -532,9 +619,10 @@ class MultiNodeCheckpointer:
         Integrity: every snapshot verifies its crc32c before any byte is
         trusted.  A corrupt newest generation falls back (with a warning)
         to the next older consistent one — *agreed across ranks*, so a
-        generation corrupt on any single rank is skipped by all.  If every
-        consistent generation is corrupt this raises rather than silently
-        restarting from scratch."""
+        generation corrupt on any single rank is skipped by all — and
+        *quarantined* (files renamed ``*.quarantined``), so no later
+        load re-verifies it.  If every consistent generation is corrupt
+        this raises rather than silently restarting from scratch."""
         self.wait()
         done = self._consistent_generations()
         # The per-generation integrity votes below are collectives, so all
@@ -548,8 +636,16 @@ class MultiNodeCheckpointer:
             return state, None
         last_err: Optional[BaseException] = None
         for it in reversed(done):
+            # Elastic rescale: a generation written by a DIFFERENT world
+            # size maps ranks onto save-time snapshots by modulo.  Valid
+            # because replicated multi-process state is saved as one
+            # full array per rank (any snapshot restores on any rank);
+            # per-device shard lists still demand a matching mesh and
+            # fail loudly in _restore_leaf.
+            world = self._marker_world(it) or self.comm.size
+            src = self.comm.rank % max(1, world)
             try:
-                loaded = _read_snapshot(self._snap(it, self.comm.rank))
+                loaded = _read_snapshot(self._snap(it, src))
                 ok = 1
             except CheckpointCorruptionError as e:
                 loaded, ok, last_err = None, 0, e
@@ -562,8 +658,13 @@ class MultiNodeCheckpointer:
             if not ok_everywhere:
                 warnings.warn(
                     f"checkpoint generation {it} is corrupt on at least one "
-                    f"rank ({last_err}); falling back to an older generation"
+                    f"rank ({last_err}); quarantining it and falling back "
+                    f"to an older generation"
                 )
+                # Rename, don't re-verify: the rejected generation drops
+                # out of _generations for good, so every later load skips
+                # straight past it.
+                self._quarantine(it)
                 continue
             if state is not None:
                 loaded = jax.tree.map(
@@ -579,7 +680,12 @@ class MultiNodeCheckpointer:
 
 
 def create_multi_node_checkpointer(
-    name: str, comm: CommunicatorBase, path: str = ".", keep: int = 2
+    name: str, comm: CommunicatorBase, path: str = ".", keep: int = 2,
+    keep_last_n: Optional[int] = None,
 ) -> MultiNodeCheckpointer:
-    """Reference-parity factory (REF:chainermn/extensions/checkpoint.py)."""
-    return MultiNodeCheckpointer(name, comm, path=path, keep=keep)
+    """Reference-parity factory (REF:chainermn/extensions/checkpoint.py).
+    ``keep_last_n`` overrides ``keep`` and also bounds retained
+    quarantined generations (docs/fault_tolerance.md)."""
+    return MultiNodeCheckpointer(
+        name, comm, path=path, keep=keep, keep_last_n=keep_last_n
+    )

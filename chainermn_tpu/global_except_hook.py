@@ -16,12 +16,22 @@ can hang the teardown — the same "die loudly, never deadlock" contract.
 
 from __future__ import annotations
 
+import json
 import os
 import sys
+import time
 import traceback
 
 _hook_installed = False
 _EXIT_CODE = 13  # distinct from interpreter default 1: "killed by crash barrier"
+_current_step = None
+
+
+def set_current_step(step) -> None:
+    """Best-effort step bookmark for the crash postmortem row (the
+    elastic runtime calls this from ``ElasticContext.beat``)."""
+    global _current_step
+    _current_step = int(step)
 
 
 def _safe_rank():
@@ -43,6 +53,52 @@ def _safe_rank():
         return -1, -1
 
 
+def _write_postmortem(rank, size, exc_type, exc_value, exc_traceback):
+    """Append one crash row — who, which step, what traceback — before
+    the process vanishes, so supervisor postmortems can name the
+    culprit.  Two sinks, each best-effort and each using the
+    torn-tail-tolerant O_APPEND JSONL contract of the step log:
+
+    * the process's installed :class:`StepRecorder`, when one is live;
+    * the file ``CHAINERMN_TPU_POSTMORTEM_FILE`` points at (the elastic
+      supervisor sets it for every rank it spawns).
+
+    Never raises: a failing postmortem must not mask the crash exit."""
+    if rank < 0:
+        # Backend not live — the elastic env still names us.
+        rank = int(os.environ.get("CHAINERMN_TPU_ELASTIC_RANK", -1))
+    tb = "".join(
+        traceback.format_exception(exc_type, exc_value, exc_traceback)
+    )[-8000:]
+    row = {
+        "event": "crash", "rank": rank, "size": size,
+        "step": _current_step, "t": time.time(),
+        "exc": f"{exc_type.__name__}: {exc_value}", "traceback": tb,
+    }
+    try:
+        from chainermn_tpu.observability.step_log import current_recorder
+
+        rec = current_recorder()
+        if rec is not None:
+            rec.record("crash", rank=rank, size=size, step=_current_step,
+                       exc=row["exc"], traceback=tb)
+    except Exception:
+        pass
+    try:
+        path = os.environ.get("CHAINERMN_TPU_POSTMORTEM_FILE")
+        if path:
+            line = (json.dumps(row) + "\n").encode("utf-8")
+            fd = os.open(
+                path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644
+            )
+            try:
+                os.write(fd, line)
+            finally:
+                os.close(fd)
+    except Exception:
+        pass
+
+
 def _handle_uncaught(exc_type, exc_value, exc_traceback):
     rank, size = _safe_rank()
     sys.stderr.write(
@@ -53,6 +109,10 @@ def _handle_uncaught(exc_type, exc_value, exc_traceback):
         "*****************************************************\n"
     )
     traceback.print_exception(exc_type, exc_value, exc_traceback)
+    try:
+        _write_postmortem(rank, size, exc_type, exc_value, exc_traceback)
+    except Exception:
+        pass
     sys.stderr.flush()
     sys.stdout.flush()
     os._exit(_EXIT_CODE)

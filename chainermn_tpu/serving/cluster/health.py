@@ -5,6 +5,10 @@ recently counts as a beat — in-process replicas beat on every step;
 remote replicas beat whenever an event batch arrives over the object
 plane (and the plane's ``PeerGone`` short-circuits the wait entirely
 when the TCP connection dies, which is faster than any timeout).
+:class:`HeartbeatMonitor` itself lives in
+:mod:`chainermn_tpu.elastic.heartbeat` — the elastic training
+supervisor monitors rank liveness with the SAME deadline machinery —
+and is re-exported here for the serving tier's callers.
 
 Scaling is *signals, not actions*: :func:`scale_signals` folds the
 fleet's load snapshots into a scale-up flag and a drain candidate,
@@ -18,47 +22,7 @@ idle that N-1 replicas could absorb it.
 
 from __future__ import annotations
 
-import time
-from typing import Callable, Dict, Iterable, List, Optional
-
-
-class HeartbeatMonitor:
-    """Deadline-based liveness over caller-supplied beats.
-
-    ``miss_after_s`` without a beat marks a replica dead;
-    :meth:`check` reports NEWLY dead replicas exactly once (the
-    router's failover trigger must not re-fire).  A beat from a dead
-    replica revives it (replacement incarnation)."""
-
-    def __init__(self, replica_ids: Iterable, miss_after_s: float = 2.0,
-                 clock: Callable[[], float] = time.monotonic):
-        self.miss_after_s = float(miss_after_s)
-        self.clock = clock
-        now = clock()
-        self._last: Dict[object, float] = {r: now for r in replica_ids}
-        self._dead: set = set()
-
-    def beat(self, replica_id, now: Optional[float] = None) -> None:
-        self._last[replica_id] = self.clock() if now is None else now
-        self._dead.discard(replica_id)
-
-    def mark_dead(self, replica_id) -> None:
-        """Out-of-band death report (e.g. a ``PeerGone`` from the
-        transport) — faster than waiting out the heartbeat deadline."""
-        self._dead.add(replica_id)
-
-    def alive(self, replica_id) -> bool:
-        return replica_id in self._last and replica_id not in self._dead
-
-    def check(self, now: Optional[float] = None) -> List:
-        """Returns replicas that died SINCE the last check."""
-        now = self.clock() if now is None else now
-        newly = [
-            r for r, t in self._last.items()
-            if r not in self._dead and now - t > self.miss_after_s
-        ]
-        self._dead.update(newly)
-        return newly
+from chainermn_tpu.elastic.heartbeat import HeartbeatMonitor  # noqa: F401
 
 
 def scale_signals(loads, *, low_free_frac: float = 0.1,

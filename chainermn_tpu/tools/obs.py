@@ -144,6 +144,22 @@ def summarize(rows: List[dict], curve_points: int = 16) -> dict:
             d["n"] += 1
         out["gauges"] = gauges
 
+    counter_rows = [r for r in rows if r.get("event") == "counter"
+                    and "name" in r]
+    if counter_rows:
+        # Monotonic counters (the elastic supervisor's elastic/restarts,
+        # elastic/preemptions, elastic/resume_generation): last value
+        # wins per (rank, name) — each row is the counter's current
+        # total, not an increment — then ranks sum.
+        clast: Dict[tuple, float] = {}
+        for r in counter_rows:
+            clast[(int(r.get("rank", 0)), str(r["name"]))] = \
+                float(r.get("value", 0.0))
+        counters: Dict[str, float] = {}
+        for (_, name), v in clast.items():
+            counters[name] = counters.get(name, 0.0) + v
+        out["counters"] = counters
+
     span_rows = [r for r in rows if r.get("event") == "span"
                  and "dur" in r and "name" in r]
     if span_rows:
@@ -281,6 +297,12 @@ def to_prometheus(summary: dict, prefix: str = "chainermn_tpu") -> str:
         metric("gauge_max", "gauge",
                "Most-loaded rank's value per set-style gauge",
                [(labels, v["max"]) for labels, v in samples])
+    counters = summary.get("counters")
+    if counters:
+        metric("counter_total", "counter",
+               "Named counters, last value per rank summed across ranks",
+               [(((("name", k),)), v)
+                for k, v in sorted(counters.items())])
     tstages = summary.get("trace_stages")
     if tstages:
         # Per-stage series overall ({stage="decode"}) AND per replica

@@ -69,12 +69,26 @@ def main(argv=None):
                    help="save a generation every N global steps")
     p.add_argument("--checkpoint-name", default="imagenet",
                    help="checkpoint set name under --checkpoint-dir")
+    p.add_argument("--elastic", action="store_true",
+                   help="join the elastic supervisor's world "
+                   "(CHAINERMN_TPU_ELASTIC_* env): heartbeats, chaos "
+                   "faults, SIGTERM-as-preemption, and plan-driven "
+                   "resharding on rescale.  A no-op outside a "
+                   "supervised run.")
     p.add_argument("--step-log", default=None, metavar="PATH",
                    help="write a JSONL step-event log (per-step timing, "
                         "loss, compile events, device memory, one "
                         "hlo_audit row); summarize with `python -m "
                         "chainermn_tpu.tools.obs summarize PATH`")
     args = p.parse_args(argv)
+
+    ctx = None
+    if args.elastic:
+        from chainermn_tpu import elastic
+
+        # Joins jax.distributed BEFORE the backend initializes below;
+        # returns None when not running under the supervisor.
+        ctx = elastic.init_from_env()
 
     comm = chainermn_tpu.create_communicator(
         args.communicator, bucket_bytes=args.bucket_bytes
@@ -196,6 +210,8 @@ def main(argv=None):
         ckpt = create_multi_node_checkpointer(
             args.checkpoint_name, comm, path=args.checkpoint_dir
         )
+        if ctx is not None:
+            ctx.attach_checkpointer(ckpt)  # arm ckpt_* chaos faults
         template = {
             "params": params, "state": state, "batch_stats": batch_stats,
             "epoch": 0, "step": 0,
@@ -211,6 +227,18 @@ def main(argv=None):
                     f"resumed from iteration {it} "
                     f"(epoch {start_epoch}, step {start_step})"
                 )
+            if ctx is not None:
+                # Rescale-ready restore: re-place params and moments for
+                # the CURRENT mesh through the sharding-plan registry —
+                # an N→M restart is plan.resolve on a different mesh.
+                params, state, plan_report = ctx.reshard(
+                    params, state, comm, plan="dp"
+                )
+                if comm.rank == 0:
+                    print(
+                        f"elastic_reshard plan=dp ok={plan_report.ok} "
+                        f"leaves={plan_report.n_leaves} world={comm.size}"
+                    )
 
     # Multi-process deployment (the reference's mpiexec shape): each
     # process draws a LOCAL slice of the global batch from its scattered
@@ -243,9 +271,27 @@ def main(argv=None):
             if skip > 0:
                 skip -= 1
                 n_steps += 1
+                if ctx is not None:
+                    ctx.beat(gstep)  # liveness during replay
                 if args.steps and n_steps >= args.steps:
                     break  # the cap counts replayed steps too
                 continue
+            if ctx is not None:
+                ctx.beat(gstep)  # chaos faults fire here, deterministically
+                if ckpt is not None and ctx.check_preemption(comm):
+                    # Grace-window synchronous checkpoint: every rank
+                    # arrives here at the same step, saves, and exits
+                    # with the preemption code (not a crash).
+                    ckpt.save(
+                        {"params": params, "state": state,
+                         "batch_stats": batch_stats,
+                         "epoch": epoch, "step": n_steps},
+                        gstep, block=True,
+                    )
+                    if comm.rank == 0:
+                        print(f"preempted: checkpoint saved at "
+                              f"iteration {gstep}")
+                    ctx.exit_preempted()
             gb = (batch[0], batch[1])
             if comm.size > 1:
                 gb = comm.global_batch(gb)

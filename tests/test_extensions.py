@@ -213,6 +213,53 @@ def test_checkpointer_rotation(tmp_path, mesh):
     assert gens == [3, 4]
 
 
+def test_checkpointer_keep_last_n_overrides_keep(tmp_path, mesh):
+    """``keep_last_n`` is the retention knob long elastic soaks tune; it
+    wins over the positional ``keep``."""
+    comm = create_communicator("naive", mesh=mesh)
+    cp = create_multi_node_checkpointer(
+        "job", comm, path=str(tmp_path), keep=2, keep_last_n=3
+    )
+    state = {"x": jnp.zeros(3)}
+    for it in (1, 2, 3, 4, 5):
+        cp.save(state, iteration=it)
+    assert cp._consistent_generations() == [3, 4, 5]
+
+
+def test_checkpointer_quarantines_corrupt_generation(tmp_path, mesh):
+    """A rejected generation is renamed ``*.quarantined`` — kept for
+    forensics, dropped from the generation list, and never re-verified
+    by a later load."""
+    import warnings
+
+    comm = create_communicator("naive", mesh=mesh)
+    cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+    state = {"w": jnp.arange(4.0)}
+    cp.save(state, iteration=1)
+    cp.save(jax.tree.map(lambda x: x + 1, state), iteration=2)
+
+    _corrupt_payload(cp._snap(2, comm.rank))
+    with pytest.warns(UserWarning, match="quarantin"):
+        got, it = cp.maybe_load(state)
+    assert it == 1
+    np.testing.assert_allclose(np.asarray(got["w"]), np.arange(4.0))
+
+    assert not os.path.exists(cp._snap(2, comm.rank))
+    assert os.path.exists(cp._snap(2, comm.rank) + ".quarantined")
+    assert not os.path.exists(cp._marker(2, comm.rank))
+    assert os.path.exists(cp._marker(2, comm.rank) + ".quarantined")
+    assert cp._consistent_generations() == [1]
+    assert cp._quarantined_generations() == [2]
+
+    # Second load never touches the quarantined bytes again: it would
+    # warn if it re-verified them, so a clean (warning-free) load is the
+    # proof the quarantine sticks.
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        got, it = cp.maybe_load(state)
+    assert it == 1
+
+
 def test_checkpointer_async_save(tmp_path, mesh):
     comm = create_communicator("naive", mesh=mesh)
     cp = create_multi_node_checkpointer("async_job", comm, path=str(tmp_path))

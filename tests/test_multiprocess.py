@@ -354,3 +354,139 @@ def test_serving_cluster_clean_run_no_kill():
     assert "SERVE_SOAK_OK" in outs[0]
     assert "SERVE_REPLICA_OK 1" in outs[1]
     assert "SERVE_REPLICA_OK 2" in outs[2]
+
+
+# ---------------------------------------------------------------------------
+# Elastic supervisor soaks: the WHOLE fault-tolerance loop over real
+# process boundaries — heartbeat-deadline detection, bounded teardown,
+# respawn/rescale, plan-validated resharding, and resume from the latest
+# consistent checkpoint generation.
+# ---------------------------------------------------------------------------
+
+_ELASTIC_WORKER = os.path.join(
+    os.path.dirname(__file__), "_elastic_train_worker.py"
+)
+
+
+def _run_elastic(workdir, ckpt, nproc, *extra, step_log=None, timeout=300):
+    """One supervised job: supervisor CLI + nproc ranks of the elastic
+    training worker.  Returns (proc, combined stdout, final report)."""
+    import json
+
+    env = subprocess_env(n_devices=1)
+    cmd = [
+        sys.executable, "-m", "chainermn_tpu.tools.elastic",
+        "--nproc", str(nproc), "--workdir", str(workdir),
+        "--hb-timeout", "30", "--grace", "5",
+    ]
+    if step_log is not None:
+        cmd += ["--step-log", str(step_log)]
+    cmd += [*extra, "--", sys.executable, _ELASTIC_WORKER,
+            "--ckpt", str(ckpt)]
+    try:
+        p = subprocess.run(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired as e:
+        pytest.fail(f"supervisor timed out:\n{e.stdout}")
+    reports = [
+        ln for ln in p.stdout.splitlines()
+        if ln.startswith("ELASTIC_REPORT ")
+    ]
+    assert reports, p.stdout
+    return p, p.stdout, json.loads(reports[-1].split(" ", 1)[1])
+
+
+def _losses(out):
+    """step -> loss from rank-0 echo lines; replayed steps overwrite."""
+    import re
+
+    return {
+        int(m.group(1)): float(m.group(2))
+        for m in re.finditer(r"step (\d+) loss ([0-9.]+)", out)
+    }
+
+
+@pytest.fixture(scope="module")
+def elastic_oracle(tmp_path_factory):
+    """Uninterrupted 2-rank supervised run — digest + loss baseline for
+    the chaos variants below."""
+    base = tmp_path_factory.mktemp("elastic_oracle")
+    p, out, report = _run_elastic(base / "work", base / "ckpt", 2)
+    assert p.returncode == 0, out
+    assert report["status"] == "ok", report
+    assert report["incarnations"] == 1, report
+    assert report["params_digest"], report
+    return {"digest": report["params_digest"], "losses": _losses(out)}
+
+
+def test_elastic_supervisor_kill9_soak(tmp_path, elastic_oracle):
+    """SIGKILL one rank mid-run: the supervisor detects, tears down the
+    survivor, respawns the world, and the resumed run's final params
+    digest is BIT-IDENTICAL to the uninterrupted oracle."""
+    log = tmp_path / "steps.jsonl"
+    p, out, report = _run_elastic(
+        tmp_path / "work", tmp_path / "ckpt", 2,
+        "--chaos", "kill:rank=1:step=5", step_log=log,
+    )
+    assert p.returncode == 0, out
+    assert report["status"] == "ok", report
+    assert report["restarts"] >= 1, report
+    assert report["resume_generation"] is not None, report
+    assert "chaos: SIGKILL" in out
+    assert report["params_digest"] == elastic_oracle["digest"], (
+        report, elastic_oracle["digest"], out,
+    )
+
+    # elastic/* counters flow through the shared observability pipeline
+    from chainermn_tpu.observability.step_log import read_records
+    from chainermn_tpu.tools.obs import summarize, to_prometheus
+
+    summary = summarize(read_records(str(log)))
+    assert summary["counters"]["elastic/restarts"] >= 1, summary
+    assert summary["counters"]["elastic/resume_generation"] >= 1, summary
+    assert summary["counters"]["elastic/preemptions"] == 0, summary
+    prom = to_prometheus(summary)
+    assert 'counter_total{name="elastic/restarts"}' in prom, prom
+
+
+def test_elastic_supervisor_rescale_2_to_1_soak(tmp_path, elastic_oracle):
+    """Kill a rank with --rescale-on-failure: the world restarts at
+    N-1=1, restored state is re-placed through the ShardingPlan registry
+    (plan-validated on the NEW mesh), and the resumed loss curve stays
+    on the 2-rank oracle curve (same math up to summation order)."""
+    p, out, report = _run_elastic(
+        tmp_path / "work", tmp_path / "ckpt", 2,
+        "--rescale-on-failure", "--min-nproc", "1",
+        "--chaos", "kill:rank=1:step=4",
+    )
+    assert p.returncode == 0, out
+    assert report["status"] == "ok", report
+    assert report["world"] == 1, report
+    assert report["restarts"] >= 1, report
+    assert "elastic_reshard plan=dp ok=True" in out, out
+    losses, oracle = _losses(out), elastic_oracle["losses"]
+    assert losses, out
+    for g, loss in losses.items():
+        assert abs(loss - oracle[g]) <= 2e-3 * max(1.0, abs(oracle[g])), (
+            g, loss, oracle[g],
+        )
+
+
+def test_elastic_supervisor_preemption_soak(tmp_path, elastic_oracle):
+    """SIGTERM = preemption: grace-window synchronous checkpoint on ALL
+    ranks, distinct exit code (counted as a preemption, not a restart),
+    resumed run bit-identical to the oracle."""
+    p, out, report = _run_elastic(
+        tmp_path / "work", tmp_path / "ckpt", 2,
+        "--chaos", "term:rank=0:step=6",
+    )
+    assert p.returncode == 0, out
+    assert report["status"] == "ok", report
+    assert report["preemptions"] >= 1, report
+    assert report["restarts"] == 0, report
+    assert "preempted: checkpoint saved at iteration 6" in out, out
+    assert report["params_digest"] == elastic_oracle["digest"], (
+        report, elastic_oracle["digest"],
+    )
