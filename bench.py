@@ -641,6 +641,8 @@ def bench_serve(comm, args):
                                            best, kd)
     if args.serve_replicas > 1:
         out["cluster"] = bench_serve_cluster(args, model, params)
+    if args.serve_traffic:
+        out["traffic"] = _serve_traffic_bench(args)
     return out
 
 
@@ -1032,6 +1034,253 @@ def bench_serve_cluster(args, model, params):
     }
 
 
+def _serve_traffic_point(args, model, params, spec, *, n_replicas,
+                         min_replicas, max_replicas,
+                         chaos_schedule=None, force_drain=False):
+    """One traffic replay over a fresh autoscaled fleet; returns the
+    workload summary plus the autoscaler/burn evidence for that point."""
+    from chainermn_tpu.elastic.chaos import ChaosSchedule, TimedChaos
+    from chainermn_tpu.observability import tracing
+    from chainermn_tpu.observability.reporter import Reporter
+    from chainermn_tpu.serving import EngineConfig, InferenceEngine
+    from chainermn_tpu.serving import workload
+    from chainermn_tpu.serving.cluster import (
+        Autoscaler,
+        AutoscalerConfig,
+        HeartbeatMonitor,
+        Replica,
+        ReplicaRouter,
+        ThreadedClusterDriver,
+    )
+
+    reporter = Reporter()
+    slo_targets = {}
+    for item in (args.serve_slo or "").split(","):
+        if "=" in item:
+            k, v = item.split("=", 1)
+            slo_targets[k.strip()] = float(v)
+    tr = None
+    if slo_targets:
+        tr = tracing.Tracer(
+            reporter=reporter,
+            slo=tracing.SLOConfig(targets=slo_targets),
+        )
+        tracing.install(tr)
+
+    def make_engine():
+        return InferenceEngine(model, params, EngineConfig(
+            block_size=args.serve_block_size,
+            n_blocks=args.serve_blocks,
+            max_len=args.serve_max_len,
+            max_batch=max(int(b) for b in
+                          args.serve_batch_sizes.split(",")),
+        ))
+
+    def make_replica(rid):
+        return Replica(rid, make_engine(), role="both",
+                       reporter=reporter, max_queue=args.serve_queue)
+
+    reps = [make_replica(i) for i in range(n_replicas)]
+    router = ReplicaRouter(
+        reps, reporter=reporter,
+        health=HeartbeatMonitor([r.replica_id for r in reps],
+                                miss_after_s=30.0),
+    )
+    scaler = Autoscaler(
+        router, make_replica,
+        AutoscalerConfig(min_replicas=min_replicas,
+                         max_replicas=max_replicas,
+                         k_up=2, cooldown_s=0.5),
+        reporter=reporter,
+    )
+    chaos = None
+    if chaos_schedule:
+        chaos = TimedChaos(ChaosSchedule.parse(chaos_schedule))
+
+    arrivals = workload.generate(spec)
+    handles = []
+    drain_fired = []
+
+    def submit(a):
+        h = router.submit(list(a.prompt), a.max_new_tokens,
+                          timeout_s=600.0, priority=a.priority)
+        handles.append(h)
+        return h
+
+    def fire(fault):
+        rid = fault.replica
+        if rid is None or rid not in router.replicas:
+            alive = [r.replica_id for r in router.replicas.values()
+                     if r.alive]
+            rid = alive[0] if alive else None
+        if rid is None:
+            return
+        if fault.kind == "kill":
+            router.fail_replica(rid, reason="chaos kill")
+        elif fault.kind == "term":
+            scaler.force_drain(rid)
+
+    try:
+        with ThreadedClusterDriver(router) as drv:
+            def pump():
+                drv.ensure_threads()
+                router.step(drive_replicas=False)
+                scaler.step()
+                if chaos is not None:
+                    for f in chaos.due():
+                        fire(f)
+                if (force_drain and not drain_fired
+                        and sum(len(h.tokens) for h in handles) >= 2):
+                    # Scale-down mid-load: live KV pages must migrate,
+                    # not drop.  Victim = the newest seed replica.
+                    if scaler.force_drain(n_replicas - 1):
+                        drain_fired.append(n_replicas - 1)
+
+            report = workload.replay(
+                arrivals, submit, pump=pump, drain_timeout_s=600.0)
+            # Let an in-flight drain finish retiring before teardown.
+            for _ in range(200):
+                if scaler._draining is None:
+                    break
+                pump()
+                time.sleep(0.01)
+            drv.run_until_idle(timeout_s=600)
+    finally:
+        if tr is not None:
+            tracing.uninstall(tr)
+            tr.close()
+
+    point = workload.summarize(report)
+    point["dropped"] = (point["offered"] - point["finished"]
+                        - point["shed"] - point["rejected"])
+    gauges = reporter.summary().get("gauges", {})
+    point["burn_rates"] = {
+        k.split("/", 2)[2]: round(float(v["value"]), 4)
+        for k, v in gauges.items() if k.startswith("slo/burn_rate/")
+    }
+    point["autoscaler_events"] = [
+        {k: (round(v, 3) if isinstance(v, float) else v)
+         for k, v in ev.items() if k != "t"}
+        for ev in scaler.events
+    ]
+    point["replicas_final"] = len(router.replicas)
+    point["_report"] = report  # stripped by the caller
+    return point
+
+
+def _serve_traffic_bench(args):
+    """``--serve-traffic``: goodput and p99 versus offered load over an
+    autoscaled fleet, a chaos point (replica SIGKILL-equivalent at peak
+    load, autoscaler backfills, streams stay bit-exact, SLO burn stays
+    under 1), and a drain-based scale-down point with zero dropped
+    streams.  Pure host orchestration — no communicator required."""
+    import jax
+    import jax.numpy as jnp
+
+    from chainermn_tpu.models.transformer import TransformerLM
+    from chainermn_tpu.serving import EngineConfig, InferenceEngine
+    from chainermn_tpu.serving import workload
+
+    model = TransformerLM(
+        vocab=args.lm_vocab, d_model=args.lm_d_model,
+        n_heads=args.lm_heads, d_ff=args.lm_d_ff,
+        n_layers=args.lm_layers, max_len=args.serve_max_len,
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )
+    spec = workload.TrafficSpec.parse(args.serve_traffic)
+    if spec.vocab >= args.lm_vocab:
+        raise SystemExit(
+            f"--serve-traffic vocab={spec.vocab} must stay below "
+            f"--lm-vocab {args.lm_vocab}")
+    if args.serve_queue is None:
+        args.serve_queue = max(4, spec.requests // 2)
+    R = max(args.serve_replicas, 1)
+    mults = sorted(float(m) for m in
+                   args.serve_load_mults.split(","))
+
+    def strip(point):
+        point.pop("_report", None)
+        return point
+
+    sweep = []
+    for mult in mults:
+        p = strip(_serve_traffic_point(
+            args, model, params, spec.scaled(mult),
+            n_replicas=R, min_replicas=R, max_replicas=R + 2,
+        ))
+        p["load_mult"] = mult
+        p["offered_rate"] = round(spec.rate * mult, 2)
+        sweep.append(p)
+    curves = {
+        "goodput_vs_offered_load": [
+            [p["offered_rate"], round(p["goodput_tps"], 2)]
+            for p in sweep],
+        "p99_vs_load": [
+            [p["offered_rate"], round(p["latency_p99_s"], 4)]
+            for p in sweep],
+    }
+    out = {
+        "spec": spec.format(),
+        "replicas": R,
+        "load_sweep": sweep,
+        "curves": curves,
+    }
+
+    # Chaos point: kill a replica at peak load; the autoscaler
+    # backfills and every surviving stream must match the oracle.
+    schedule = args.serve_chaos
+    if schedule == "auto":
+        schedule = f"kill:replica={R - 1}:at=0.75"
+    if schedule and schedule != "none":
+        p = _serve_traffic_point(
+            args, model, params, spec.scaled(mults[-1]),
+            n_replicas=R, min_replicas=R, max_replicas=R + 2,
+            chaos_schedule=schedule,
+        )
+        report = p.pop("_report")
+        oracle = InferenceEngine(model, params, EngineConfig(
+            block_size=args.serve_block_size,
+            n_blocks=args.serve_blocks,
+            max_len=args.serve_max_len, max_batch=1,
+        ))
+        mismatches = [
+            o.arrival.index for o in report.outcomes if o.finished
+            and list(o.handle.tokens) != oracle.generate(
+                list(o.arrival.prompt), o.arrival.max_new_tokens)
+        ]
+        burn = max(p["burn_rates"].values(), default=0.0)
+        out["chaos"] = {
+            "schedule": schedule,
+            "point": p,
+            "backfilled": any(ev["action"] == "spawn"
+                              and ev.get("reason") == "backfill"
+                              for ev in p["autoscaler_events"]),
+            "parity": "ok" if not mismatches else "FAIL",
+            "parity_mismatches": mismatches,
+            "slo_green": burn < 1.0,
+        }
+
+    # Scale-down point: one extra replica at the lightest load; the
+    # autoscaler drains it mid-stream (live KV migrates) and retires
+    # it — zero dropped streams is the acceptance bar.
+    p = strip(_serve_traffic_point(
+        args, model, params, spec.scaled(mults[0]),
+        n_replicas=R + 1, min_replicas=R, max_replicas=R + 1,
+        force_drain=True,
+    ))
+    out["scale_down"] = {
+        "point": p,
+        "drained": any(ev["action"] == "drain"
+                       for ev in p["autoscaler_events"]),
+        "retired": any(ev["action"] == "retire"
+                       for ev in p["autoscaler_events"]),
+        "dropped_streams": p["dropped"],
+    }
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--only", choices=["resnet", "lm"], default=None,
@@ -1122,6 +1371,36 @@ def main(argv=None):
                          "template (duplicate-prefix load for the "
                          "prefix cache); >0 also reports the "
                          "no-sharing baseline and speedup")
+    ap.add_argument("--serve-traffic", default=None, metavar="SPEC",
+                    help="SLO-guarded degradation curves: replay a "
+                         "seeded heavy-tailed workload (MMPP bursts, "
+                         "Zipf shared prefixes, priority classes — "
+                         "serving.workload.TrafficSpec 'key=value,...' "
+                         "or 'default') over an autoscaled fleet at "
+                         "each --serve-load-mults point, emitting "
+                         "goodput-vs-offered-load and p99-vs-load "
+                         "curves plus a chaos point (replica killed at "
+                         "peak load, autoscaler backfills, streams "
+                         "bit-exact) and a drain-based scale-down "
+                         "point with zero dropped streams; alone it "
+                         "is its own bench mode, with --serve it "
+                         "rides along as a \"traffic\" section")
+    ap.add_argument("--serve-load-mults", default="0.5,1,2",
+                    help="offered-load multipliers on the traffic "
+                         "spec's base rate for the --serve-traffic "
+                         "sweep")
+    ap.add_argument("--serve-chaos", default="auto", metavar="SCHEDULE",
+                    help="timed fault schedule for the --serve-traffic "
+                         "chaos point (docs/fault_tolerance.md grammar "
+                         "with replica=/at= coordinates, e.g. "
+                         "'kill:replica=1:at=0.75'); 'auto' kills the "
+                         "last seed replica at peak load, 'none' "
+                         "skips the chaos point")
+    ap.add_argument("--serve-slo", default="queue=30,decode=30",
+                    help="per-stage latency targets 'stage=seconds,...'"
+                         " for the --serve-traffic burn-rate gauges "
+                         "(lenient defaults suit compile-dominated CPU "
+                         "runs); empty string disables SLO tracking")
     ap.add_argument("--serve-spec-tokens", type=int, default=3,
                     help="speculative draft length for the serve "
                          "sweep's spec-ON column (OFF column always "
@@ -1171,10 +1450,16 @@ def main(argv=None):
     ap.add_argument("--chaos-nproc", type=int, default=2,
                     help="world size for the --chaos soak")
     args = ap.parse_args(argv)
-    if args.chaos and not args.serve and args.only is None:
+    if args.chaos and not args.serve and not args.serve_traffic \
+            and args.only is None:
         # Chaos-only mode: pure process orchestration, no device bench
         # (and no backend init in THIS process).
         print(json.dumps({"chaos": _chaos_soak(args)}))
+        return
+    if args.serve_traffic and not args.serve and args.only is None:
+        # Traffic-only mode: host-side serving orchestration; no
+        # communicator, default JSON shape untouched.
+        print(json.dumps({"serve_traffic": _serve_traffic_bench(args)}))
         return
     if not args.no_overlap:
         # Seed the latency-hiding / async-collective XLA flags before the

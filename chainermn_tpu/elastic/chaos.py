@@ -31,6 +31,18 @@ Kinds:
 
 The schedule drives both the test suite and ``bench.py --chaos``; the
 supervisor passes it to ranks via ``CHAINERMN_TPU_CHAOS``.
+
+Serving-tier coordinates: the same grammar also addresses *serving
+replicas* on a *wall-clock* axis — ``kill:replica=1:at=0.25`` kills
+replica 1 a quarter second into a traffic run.  ``replica=`` targets a
+replica id instead of a training rank, and ``at=`` (seconds since the
+harness armed) replaces ``step=`` where there is no shared step counter
+— a cluster of free-running replica threads has no step, only time.
+``kill``/``term`` accept either coordinate; :class:`TimedChaos` is the
+serving-side executor that fires ``at=`` faults exactly once as their
+deadline passes (the *caller* maps the fault onto an action —
+``router.fail_replica`` for an in-process harness, a real ``SIGKILL``
+for a multi-process one — so the grammar stays policy-free).
 """
 
 from __future__ import annotations
@@ -40,15 +52,17 @@ import os
 import signal
 import sys
 import time
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
 ENV_SCHEDULE = "CHAINERMN_TPU_CHAOS"
 
 _KINDS = ("kill", "term", "hb_stall", "ckpt_corrupt", "ckpt_torn",
           "ckpt_slow")
+# kill/term fire at a training step OR a wall-clock offset (one of the
+# tuple suffices); every other kind keeps its fixed requirement.
 _REQUIRED = {
-    "kill": ("step",),
-    "term": ("step",),
+    "kill": (("step", "at"),),
+    "term": (("step", "at"),),
     "hb_stall": ("step", "secs"),
     "ckpt_corrupt": ("gen",),
     "ckpt_torn": ("gen",),
@@ -64,6 +78,8 @@ class Fault:
     gen: Optional[int] = None
     secs: float = 0.0
     inc: int = 0  # incarnation the fault belongs to (-1: every one)
+    replica: Optional[int] = None  # serving-replica target (vs. rank)
+    at: Optional[float] = None  # seconds since harness start (vs. step)
 
     def targets(self, rank: int, incarnation: int) -> bool:
         if self.rank is not None and self.rank != rank:
@@ -74,8 +90,12 @@ class Fault:
         parts = [self.kind]
         if self.rank is not None:
             parts.append(f"rank={self.rank}")
+        if self.replica is not None:
+            parts.append(f"replica={self.replica}")
         if self.step is not None:
             parts.append(f"step={self.step}")
+        if self.at is not None:
+            parts.append(f"at={self.at:g}")
         if self.gen is not None:
             parts.append(f"gen={self.gen}")
         if self.secs:
@@ -111,19 +131,29 @@ class ChaosSchedule:
                     )
                 k, v = kv.split("=", 1)
                 k = k.strip()
-                if k in ("rank", "step", "gen", "inc"):
+                if k in ("rank", "step", "gen", "inc", "replica"):
                     kw[k] = int(v)
-                elif k == "secs":
+                elif k in ("secs", "at"):
                     kw[k] = float(v)
                 else:
                     raise ValueError(
                         f"chaos: unknown key {k!r} in {item!r}"
                     )
-            missing = [k for k in _REQUIRED[kind] if k not in kw]
+            missing = [
+                req for req in _REQUIRED[kind]
+                if not any(
+                    k in kw
+                    for k in (req if isinstance(req, tuple) else (req,))
+                )
+            ]
             if missing:
+                names = [
+                    "|".join(m) if isinstance(m, tuple) else m
+                    for m in missing
+                ]
                 raise ValueError(
                     f"chaos: fault {kind!r} requires "
-                    f"{'/'.join(missing)} in {item!r}"
+                    f"{'/'.join(names)} in {item!r}"
                 )
             faults.append(Fault(kind=kind, **kw))
         return cls(tuple(faults))
@@ -134,6 +164,16 @@ class ChaosSchedule:
     def for_rank(self, rank: int, incarnation: int) -> Tuple[Fault, ...]:
         return tuple(
             f for f in self.faults if f.targets(rank, incarnation)
+        )
+
+    def timed(self) -> Tuple[Fault, ...]:
+        """Faults on the wall-clock axis (``at=``), in firing order —
+        the subset a :class:`TimedChaos` executor arms."""
+        return tuple(
+            sorted(
+                (f for f in self.faults if f.at is not None),
+                key=lambda f: f.at,
+            )
         )
 
 
@@ -237,3 +277,89 @@ def engine_from_env(rank: int, incarnation: int,
     return ChaosEngine(
         ChaosSchedule.parse(text), rank, incarnation, heartbeat=heartbeat
     )
+
+
+class TimedChaos:
+    """Serving-side executor for ``at=`` faults.
+
+    Training chaos fires inside the victim at its own step counter;
+    serving replicas free-run with no shared step, so the only
+    reproducible coordinate is elapsed time since the harness armed.
+    :meth:`due` returns each fault exactly once when its deadline
+    passes — the caller maps it onto an action (``fail_replica`` for
+    thread replicas, ``os.kill`` for process ones), keeping the grammar
+    itself free of any cluster policy."""
+
+    def __init__(self, schedule: ChaosSchedule,
+                 clock=time.monotonic):
+        self.clock = clock
+        self._armed = list(schedule.timed())
+        self._t0: Optional[float] = None
+
+    def start(self, now: Optional[float] = None) -> None:
+        self._t0 = self.clock() if now is None else now
+
+    @property
+    def pending(self) -> int:
+        return len(self._armed)
+
+    def due(self, now: Optional[float] = None) -> Tuple[Fault, ...]:
+        """Newly-due faults (armed, deadline passed), oldest first.
+        Arms the clock lazily on first call so bare ``due()`` polling
+        works without an explicit :meth:`start`."""
+        now = self.clock() if now is None else now
+        if self._t0 is None:
+            self._t0 = now
+        elapsed = now - self._t0
+        fired = tuple(f for f in self._armed if f.at <= elapsed)
+        if fired:
+            self._armed = [f for f in self._armed if f.at > elapsed]
+        return fired
+
+
+# Canonical corpus for grammar smoke checks (``tools.lint --self``):
+# every accepted form round-trips parse→format→parse unchanged, and
+# each rejected form must raise — so a grammar regression is caught by
+# the same lint gate that guards source hygiene.
+GRAMMAR_CORPUS_OK = (
+    "kill:rank=1:step=5",
+    "term:rank=0:step=8;hb_stall:rank=1:step=3:secs=30",
+    "ckpt_corrupt:rank=0:gen=4;ckpt_torn:rank=1:gen=6;ckpt_slow:secs=0.05",
+    "kill:replica=1:at=0.25",
+    "kill:replica=2:at=1.5;term:replica=0:at=3",
+    "kill:rank=1:step=5:inc=-1",
+)
+GRAMMAR_CORPUS_BAD = (
+    "explode:rank=1:step=5",        # unknown kind
+    "kill:rank=1",                  # kill needs step or at
+    "kill:replica=1",               # ... regardless of target axis
+    "hb_stall:rank=1:step=3",       # hb_stall needs secs
+    "kill:rank=1:step",             # not key=value
+    "kill:rank=1:when=5",           # unknown key
+)
+
+
+def validate_grammar() -> List[str]:
+    """Self-check the schedule grammar against the canonical corpus.
+    Returns a list of problems (empty when healthy)."""
+    problems: List[str] = []
+    for text in GRAMMAR_CORPUS_OK:
+        try:
+            sched = ChaosSchedule.parse(text)
+            rt = ChaosSchedule.parse(sched.format())
+            if rt != sched:
+                problems.append(
+                    f"chaos grammar: {text!r} does not round-trip "
+                    f"(format() -> {sched.format()!r})"
+                )
+        except ValueError as e:
+            problems.append(f"chaos grammar: {text!r} rejected: {e}")
+    for text in GRAMMAR_CORPUS_BAD:
+        try:
+            ChaosSchedule.parse(text)
+        except ValueError:
+            continue
+        problems.append(
+            f"chaos grammar: invalid schedule {text!r} was accepted"
+        )
+    return problems

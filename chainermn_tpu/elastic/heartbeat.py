@@ -48,6 +48,13 @@ class HeartbeatMonitor:
         waiting out the heartbeat deadline."""
         self._dead.add(replica_id)
 
+    def forget(self, replica_id) -> None:
+        """Stop tracking a peer that left *on purpose* (a drained and
+        retired replica) — without this, its silence would read as a
+        death and re-fire the failover path."""
+        self._last.pop(replica_id, None)
+        self._dead.discard(replica_id)
+
     def alive(self, replica_id) -> bool:
         return replica_id in self._last and replica_id not in self._dead
 

@@ -57,3 +57,7 @@ from chainermn_tpu.serving.scheduler import (  # noqa: F401
     Request,
     RequestState,
 )
+from chainermn_tpu.serving.workload import (  # noqa: F401
+    Arrival,
+    TrafficSpec,
+)

@@ -16,12 +16,20 @@ this package turns N of them into a routed fleet:
   slice, move them (in-process or over the typed socket plane), restore
   with :meth:`PagedKVCache.assert_consistent` holding;
 * :mod:`health` — heartbeat liveness and watermark-driven scale/drain
-  signals as Reporter gauges;
+  signals as Reporter gauges, plus the hysteresis filter debouncing
+  them;
+* :mod:`autoscaler` — the closed-loop controller acting on those
+  signals and the SLO burn-rate gauges: spawn on pressure, drain →
+  migrate → retire on idleness, emergency backfill on death;
 * :mod:`driver` — threaded per-replica stepping for benchmarks;
 * :mod:`service` — router/replica event loops over the ObjectPlane for
   real multi-process deployments (``python -m chainermn_tpu.tools.serve``).
 """
 
+from chainermn_tpu.serving.cluster.autoscaler import (  # noqa: F401
+    Autoscaler,
+    AutoscalerConfig,
+)
 from chainermn_tpu.serving.cluster.disagg import (  # noqa: F401
     PrefillJob,
     PrefillResult,
@@ -31,6 +39,7 @@ from chainermn_tpu.serving.cluster.driver import (  # noqa: F401
 )
 from chainermn_tpu.serving.cluster.health import (  # noqa: F401
     HeartbeatMonitor,
+    ScaleSignalFilter,
     scale_signals,
 )
 from chainermn_tpu.serving.cluster.migration import (  # noqa: F401

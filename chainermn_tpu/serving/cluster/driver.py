@@ -41,6 +41,11 @@ class ThreadedClusterDriver:
         self.heartbeat = heartbeat
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        #: replica ids ever given a thread — ensure_threads() is
+        #: idempotent across autoscaler spawns (a retired id is NOT
+        #: reused; its thread exited on the alive flip).
+        self._known: set = set()
+        self._started = False
 
     def _worker(self, replica) -> None:
         while not self._stop.is_set():
@@ -60,23 +65,45 @@ class ThreadedClusterDriver:
             if not busy:
                 time.sleep(self.idle_sleep_s)
 
+    def _spawn_thread(self, rep) -> None:
+        t = threading.Thread(
+            target=self._worker, args=(rep,), daemon=True,
+            name=f"replica-{rep.replica_id}",
+        )
+        t.start()
+        self._threads.append(t)
+        self._known.add(rep.replica_id)
+
     def start(self) -> "ThreadedClusterDriver":
-        if self._threads:
+        if self._started:
             raise RuntimeError("driver already started")
-        for rep in self.router.replicas.values():
-            t = threading.Thread(
-                target=self._worker, args=(rep,), daemon=True,
-                name=f"replica-{rep.replica_id}",
-            )
-            t.start()
-            self._threads.append(t)
+        self._started = True
+        for rep in list(self.router.replicas.values()):
+            self._spawn_thread(rep)
         return self
+
+    def ensure_threads(self) -> int:
+        """Give any replica that joined the fleet since the last call
+        (autoscaler scale-up) its stepping thread.  Returns how many
+        were started.  Called from the policy pump — the autoscaler
+        spawns, the pump wires."""
+        if not self._started:
+            return 0
+        started = 0
+        for rep in list(self.router.replicas.values()):
+            if rep.replica_id not in self._known:
+                self._spawn_thread(rep)
+                started += 1
+        return started
 
     def stop(self, timeout_s: Optional[float] = 10.0) -> None:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=timeout_s)
         self._threads = []
+        self._known = set()
+        self._started = False
+        self._stop = threading.Event()
 
     def __enter__(self) -> "ThreadedClusterDriver":
         return self.start()
@@ -90,6 +117,7 @@ class ThreadedClusterDriver:
         ``timeout_s`` elapses — RuntimeError, streams intact)."""
         deadline = time.monotonic() + timeout_s
         while self.router.has_work:
+            self.ensure_threads()
             self.router.step(drive_replicas=False)
             if time.monotonic() > deadline:
                 raise RuntimeError(

@@ -22,6 +22,9 @@ idle that N-1 replicas could absorb it.
 
 from __future__ import annotations
 
+import time
+from typing import Callable, Optional
+
 from chainermn_tpu.elastic.heartbeat import HeartbeatMonitor  # noqa: F401
 
 
@@ -91,3 +94,78 @@ def scale_signals(loads, *, low_free_frac: float = 0.1,
         reporter.gauge("serving/cluster/queued", queued)
         reporter.gauge("serving/cluster/replicas_alive", len(loads))
     return out
+
+
+class ScaleSignalFilter:
+    """Hysteresis + cooldown debouncer between :func:`scale_signals`
+    and any actuator.
+
+    Raw watermark signals flap: one bursty arrival batch trips
+    ``scale_up`` for a single observation, one idle tick nominates a
+    drain candidate that is busy again a millisecond later.  An
+    actuator that obeys every observation oscillates — spawn, drain,
+    spawn — paying replica cold-start on each swing.  The filter passes
+    a decision through only when it has been observed ``k_up`` /
+    ``k_down`` times *consecutively* (a drain vote must nominate the
+    SAME candidate each time — a flap between candidates resets the
+    count), and refuses any decision inside ``cooldown_s`` of the last
+    one, so the fleet settles between actions.
+    """
+
+    def __init__(self, k_up: int = 3, k_down: int = 5,
+                 cooldown_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if k_up < 1 or k_down < 1:
+            raise ValueError("hysteresis counts must be >= 1")
+        self.k_up = int(k_up)
+        self.k_down = int(k_down)
+        self.cooldown_s = float(cooldown_s)
+        self.clock = clock
+        self._up_streak = 0
+        self._down_streak = 0
+        self._down_candidate = None
+        self._last_decision_t: Optional[float] = None
+
+    def _in_cooldown(self, now: float) -> bool:
+        return (
+            self._last_decision_t is not None
+            and now - self._last_decision_t < self.cooldown_s
+        )
+
+    def update(self, signals: dict,
+               now: Optional[float] = None) -> dict:
+        """Feed one :func:`scale_signals` observation; returns
+        ``{"scale_up": bool, "drain": candidate_or_None}`` with the
+        debounced decision (at most one direction per call).  Streaks
+        survive a cooldown window — sustained pressure acts the moment
+        the window expires — but emitting a decision resets both."""
+        now = self.clock() if now is None else now
+
+        if signals.get("scale_up"):
+            self._up_streak += 1
+        else:
+            self._up_streak = 0
+
+        cand = signals.get("drain_candidate")
+        if cand is not None and cand == self._down_candidate:
+            self._down_streak += 1
+        elif cand is not None:
+            self._down_candidate = cand
+            self._down_streak = 1
+        else:
+            self._down_candidate = None
+            self._down_streak = 0
+
+        out = {"scale_up": False, "drain": None}
+        if self._in_cooldown(now):
+            return out
+        if self._up_streak >= self.k_up:
+            out["scale_up"] = True
+        elif self._down_streak >= self.k_down:
+            out["drain"] = self._down_candidate
+        if out["scale_up"] or out["drain"] is not None:
+            self._last_decision_t = now
+            self._up_streak = 0
+            self._down_streak = 0
+            self._down_candidate = None
+        return out

@@ -45,9 +45,14 @@ from chainermn_tpu.serving.cluster.disagg import (
     place_handoff,
 )
 from chainermn_tpu.serving.cluster.health import HeartbeatMonitor
+from chainermn_tpu.serving.cluster.migration import (
+    extract_sequence,
+    restore_sequence,
+)
 from chainermn_tpu.serving.cluster.replica import Replica, ReplicaLoad
 from chainermn_tpu.serving.engine import SamplingParams
 from chainermn_tpu.serving.frontend import QueueFull
+from chainermn_tpu.serving.kv_cache import OutOfBlocks
 from chainermn_tpu.serving.scheduler import Request
 
 
@@ -70,6 +75,11 @@ class ClusterHandle:
     error: Optional[str] = None
     replica_id: Optional[object] = None
     failovers: int = 0
+    #: shed class (0 = most important) — travels with every placement.
+    priority: int = 0
+    #: times this stream moved replicas via live KV-page migration
+    #: (scale-down drains; distinct from failover replays).
+    migrations: int = 0
     #: (replica_id, replica-local request id) of the live placement.
     _local: Optional[Tuple[object, int]] = None
     #: trace id when tracing is active (None otherwise).
@@ -201,6 +211,25 @@ class ReplicaRouter:
                 best, best_key = rep, key
         return best
 
+    def _pick_shed_target(self, priority: int) -> Optional[Replica]:
+        """When nothing admits an arrival, the replica whose full queue
+        holds the *cheapest* victim strictly below ``priority`` — the
+        frontend there sheds it at submission.  None when overload is
+        uniform at-or-above this class (the arrival is rejected)."""
+        best, best_key = None, None
+        for rep in self.replicas.values():
+            if not (rep.alive and not rep.draining and rep.can_decode):
+                continue
+            if rep.frontend.queue_depth() < rep.frontend.max_queue:
+                continue  # not queue-bound: don't shed to jump pages
+            victim = rep.frontend.sheddable_class(priority)
+            if victim is None:
+                continue
+            key = (victim, repr(rep.replica_id))
+            if best_key is None or key > best_key:
+                best, best_key = rep, key
+        return best
+
     def _pick_prefill_replica(self) -> Optional[Replica]:
         best, best_key = None, None
         for rep in self.replicas.values():
@@ -219,10 +248,14 @@ class ReplicaRouter:
                stop_token: Optional[int] = None,
                timeout_s: Optional[float] = None,
                on_token: Optional[Callable[[int, int], None]] = None,
+               priority: int = 0,
                ) -> ClusterHandle:
         """Route one request; raises :class:`QueueFull` (with the
         minimum retry-after hint across replicas) when no replica
-        admits it."""
+        admits it.  ``priority`` is the shed class (0 = most
+        important): when every queue is full, an arrival may displace
+        a strictly lower-class waiting request instead of being
+        rejected (see ``ServeFrontend.submit``)."""
         gid = self._next_gid
         self._next_gid += 1
         handle = ClusterHandle(
@@ -234,6 +267,7 @@ class ReplicaRouter:
             timeout_s=timeout_s,
             submitted_at=self.clock(),
             on_token=on_token,
+            priority=int(priority),
         )
         self._handles[gid] = handle
         tr = _tracing.get_tracer()
@@ -305,7 +339,14 @@ class ReplicaRouter:
             prompt_tokens=handle.prompt,
         )
         if rep is None:
+            rep = self._pick_shed_target(handle.priority)
+        if rep is None:
             self._handles.pop(handle.request_id, None)
+            if self.reporter is not None:
+                # Mirror the frontend's per-class reject counter: a
+                # fleet-wide rejection never reaches any frontend.
+                self.reporter.count(
+                    f"serve/rejected/{handle.priority}", 1)
             hints = [
                 r.frontend._retry_after_hint()
                 for r in self.replicas.values() if r.alive
@@ -324,6 +365,7 @@ class ReplicaRouter:
                 on_token=lambda _rid, tok: handle._commit(tok),
                 committed=committed,
                 trace=root,
+                priority=handle.priority,
             )
         if tr is not None and root is not None:
             tr.record_span("placement", root, t0, tr.clock() - t0,
@@ -591,11 +633,212 @@ class ReplicaRouter:
             )
         return list(handle.tokens)
 
+    # -- membership (autoscaling) --------------------------------------
+    def add_replica(self, replica: Replica) -> Replica:
+        """Join a freshly spawned replica to the fleet (scale-up).  It
+        becomes routable immediately; a :class:`ThreadedClusterDriver`
+        picks it up on its next ``ensure_threads()``."""
+        if replica.replica_id in self.replicas:
+            raise ValueError(
+                f"replica id {replica.replica_id!r} already in fleet"
+            )
+        self.replicas[replica.replica_id] = replica
+        if self.health is not None:
+            self.health.beat(replica.replica_id)
+        if self.reporter is not None:
+            self.reporter.count("serving/cluster/replicas_added", 1)
+        return replica
+
     # -- drain / scale-down --------------------------------------------
     def drain(self, replica_id) -> None:
         """Stop routing NEW work to ``replica_id``; its in-flight
         streams finish normally.  The graceful half of scale-down."""
         self.replicas[replica_id].draining = True
+
+    def migrate_out(self, replica_id) -> int:
+        """Move every live stream off ``replica_id`` (typically
+        draining) onto survivors, waiting requests by resubmission and
+        RUNNING ones by live KV-page migration — the committed stream
+        never stalls past one extract/restore, no token is dropped or
+        regenerated.  Returns how many streams moved.  A stream with no
+        viable target stays put (it finishes where it is; retirement
+        just waits).
+        """
+        src = self.replicas.get(replica_id)
+        if src is None:
+            return 0
+        moved = 0
+        now = self.clock()
+        for (rid, lid), handle in list(self._by_local.items()):
+            if rid != replica_id or handle.done:
+                continue
+            with src.lock:
+                local = src.frontend._handles.get(lid)
+                req = local._request if local is not None else None
+                if req is None or req.done:
+                    continue
+                sched = src.scheduler
+                if req in sched.waiting:
+                    # Not admitted yet: no device state, nothing to
+                    # migrate — pull it out and re-place it whole.
+                    sched.waiting.remove(req)
+                    src.frontend._handles.pop(lid, None)
+                    snap, target = None, None
+                elif req in sched.running:
+                    target = self._pick_adopt_target(req, exclude=rid)
+                    if target is None:
+                        continue
+                    # Between iterations (we hold src.lock) the pages
+                    # cover exactly len(context)-1 positions — the last
+                    # generated token is the next step's input.  That is
+                    # precisely the adoption contract on the other side.
+                    sched.running.remove(req)
+                    snap = extract_sequence(
+                        src.engine, lid, context=req.context,
+                        prompt_len=len(req.prompt),
+                    )
+                    src.engine.kv.free(lid)
+                    src.frontend._handles.pop(lid, None)
+                else:
+                    continue
+            del self._by_local[(rid, lid)]
+            handle._local = None
+            handle.migrations += 1
+            if snap is None:
+                try:
+                    self._place(handle, committed=list(handle.tokens))
+                    self._handles[handle.request_id] = handle
+                except QueueFull:
+                    # Survivors refused after all — give the slot we
+                    # just vacated back to src; retirement waits.
+                    self._return_to(src, handle)
+                    continue
+            else:
+                if not self._adopt_on(target, src, handle, snap, req,
+                                      now):
+                    continue
+            if self.reporter is not None and not handle.done:
+                self.reporter.count("serving/cluster/migrations", 1)
+            moved += 1
+        return moved
+
+    def _return_to(self, src: Replica, handle: ClusterHandle) -> None:
+        """Re-home a stream onto the replica it was being migrated off
+        (committed-prefix replay) — the no-harm fallback when no
+        survivor can take it.  Bypasses routing: ``src`` may be
+        draining, but it still owes its own streams."""
+        try:
+            with src.lock:
+                local = src.frontend.submit(
+                    handle.prompt, handle.max_new_tokens,
+                    sampling=handle.sampling,
+                    stop_token=handle.stop_token,
+                    timeout_s=handle._remaining_timeout(self.clock()),
+                    on_token=lambda _rid, tok: handle._commit(tok),
+                    committed=list(handle.tokens),
+                    trace=handle._trace_root,
+                    priority=handle.priority,
+                )
+        except QueueFull as e:
+            handle.status = "failed"
+            handle.error = f"drain migration found no placement: {e}"
+            return
+        handle.status = "routed"
+        handle.replica_id = src.replica_id
+        handle._local = (src.replica_id, local.request_id)
+        self._by_local[handle._local] = handle
+        self._handles[handle.request_id] = handle
+
+    def _pick_adopt_target(self, req: Request,
+                           exclude=None) -> Optional[Replica]:
+        """Best survivor that can adopt ``req``'s live pages RIGHT NOW:
+        an open batch slot and enough free pages for the sequence (the
+        watermark held back, as at admission)."""
+        best, best_key = None, None
+        for rep in self.replicas.values():
+            if rep.replica_id == exclude:
+                continue
+            load = rep.load()
+            if not (load.alive and not load.draining
+                    and rep.can_decode
+                    and load.running < load.max_batch):
+                continue
+            need = rep.engine.kv.blocks_for(len(req.context))
+            if load.free_blocks < need + rep.scheduler.watermark:
+                continue
+            key = (self.score(load), repr(rep.replica_id))
+            if best_key is None or key > best_key:
+                best, best_key = rep, key
+        return best
+
+    def _adopt_on(self, target: Replica, src: Replica,
+                  handle: ClusterHandle, snap, req: Request,
+                  now: float) -> bool:
+        """Restore ``snap`` on ``target`` and adopt the stream there.
+        On restore failure (lost a page race to target's own
+        admissions) falls back to committed-prefix replay — slower, but
+        the stream stays bit-exact either way."""
+        adopted = False
+        with target.lock:
+            rid2 = target.frontend.reserve_id()
+            try:
+                restore_sequence(target.engine, snap, rid2)
+                req2 = Request(
+                    request_id=rid2,
+                    prompt=list(handle.prompt),
+                    max_new_tokens=handle.max_new_tokens,
+                    sampling=handle.sampling,
+                    stop_token=handle.stop_token,
+                    on_token=lambda _rid, tok: handle._commit(tok),
+                    trace=handle._trace_root,
+                    priority=handle.priority,
+                )
+                req2.generated = list(req.generated)
+                target.frontend.adopt(
+                    req2, timeout_s=handle._remaining_timeout(now)
+                )
+                adopted = True
+            except OutOfBlocks:
+                if rid2 in target.engine.kv:
+                    target.engine.kv.free(rid2)
+        if not adopted:
+            try:
+                self._place(handle, committed=list(handle.tokens))
+                self._handles[handle.request_id] = handle
+            except QueueFull:
+                self._return_to(src, handle)
+                return False
+            return True
+        handle.status = "routed"
+        handle.replica_id = target.replica_id
+        handle._local = (target.replica_id, rid2)
+        self._by_local[handle._local] = handle
+        return True
+
+    def retire_replica(self, replica_id) -> bool:
+        """Remove a DRAINED replica from the fleet (scale-down's final
+        step).  Refuses — returns False — while any live stream, queued
+        prefill, or unplaced handoff still lives there, so calling it
+        in a loop after :meth:`drain` + :meth:`migrate_out` retires
+        with zero dropped streams.  The replica's driver thread exits
+        on the ``alive`` flip."""
+        rep = self.replicas.get(replica_id)
+        if rep is None:
+            return True
+        busy = any(
+            rid == replica_id and not h.done
+            for (rid, _), h in self._by_local.items()
+        )
+        with rep.lock:
+            if busy or rep.has_work:
+                return False
+            rep.alive = False
+        del self.replicas[replica_id]
+        if self.health is not None:
+            self.health.forget(replica_id)
+        if self.reporter is not None:
+            self.reporter.count("serving/cluster/replicas_retired", 1)
+        return True
 
     def loads(self, now: Optional[float] = None) -> List[ReplicaLoad]:
         now = self.clock() if now is None else now

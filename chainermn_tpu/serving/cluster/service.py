@@ -329,7 +329,8 @@ def run_router(size: int, requests: List[dict],
                timeout_s: float = 300.0,
                reporter=None,
                plane: Optional[ObjectPlane] = None,
-               flight_path: Optional[str] = None) -> Dict[int, dict]:
+               flight_path: Optional[str] = None,
+               slo=None) -> Dict[int, dict]:
     """Drive ``requests`` (dicts: prompt, max_new_tokens, optional
     sampling/stop_token/timeout_s) to completion over replicas at
     subgroup ranks ``1..size-1``.  Returns ``{gid: {"tokens": [...],
@@ -339,12 +340,22 @@ def run_router(size: int, requests: List[dict],
     ``flight_path`` — install a FlightRecorder-backed tracer for the
     duration; the router owns every request's ROOT span (it survives
     replica failover), replicas contribute stage spans via the
-    ``trace`` field on CMD frames."""
+    ``trace`` field on CMD frames.
+
+    ``slo`` — an :class:`~chainermn_tpu.observability.tracing.SLOConfig`;
+    installs a tracer (even without ``flight_path``) wired to
+    ``reporter`` so ``slo/burn_rate/<stage>`` gauges accumulate on the
+    router, where stage spans from every replica converge."""
     tr = None
-    if flight_path is not None and _tracing.get_tracer() is None:
+    if (flight_path is not None or slo is not None) \
+            and _tracing.get_tracer() is None:
+        flight = None
+        if flight_path is not None:
+            flight = _tracing.FlightRecorder(flight_path,
+                                             replica="router")
         tr = _tracing.Tracer(
-            flight=_tracing.FlightRecorder(flight_path, replica="router"),
-            replica="router",
+            flight=flight, replica="router",
+            reporter=reporter, slo=slo,
         )
         _tracing.install(tr)
     try:

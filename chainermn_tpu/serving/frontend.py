@@ -6,6 +6,18 @@ The frontend is the boundary between callers and the scheduler loop:
   :class:`RequestHandle` or raises :class:`QueueFull` — bounded-queue
   backpressure, so a bursty producer finds out *at submission time*
   rather than growing an unbounded backlog;
+* priority-aware shedding: every request carries a priority class
+  (0 = most important).  At capacity, an arriving request sheds the
+  *lowest*-class waiting request iff that victim's class is strictly
+  lower than its own — overload degrades the cheap traffic first,
+  never inverts priorities, and both sides are counted per class
+  (``serve/admit/<class>``, ``serve/shed/<class>``,
+  ``serve/rejected/<class>``) for the load-shedding curves;
+* retry hints are *jittered*: every :class:`QueueFull` scales its
+  ``retry_after_s`` by a deterministic per-frontend random factor in
+  [0.75, 1.25), so a thousand clients rejected in the same burst do
+  not come back in the same burst (the classic synchronized retry
+  storm);
 * per-request deadlines: a request that exceeds its ``timeout_s``
   (measured from submission, via an injectable clock so tests don't
   sleep) is cancelled wherever it is — dropped from the queue, or
@@ -22,6 +34,7 @@ the whole serving stack deterministic and testable in-process.
 from __future__ import annotations
 
 import dataclasses
+import random
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -104,7 +117,8 @@ class ServeFrontend:
     def __init__(self, scheduler: ContinuousBatchingScheduler,
                  max_queue: int = 64,
                  clock: Callable[[], float] = time.monotonic,
-                 replica=None):
+                 replica=None,
+                 reporter=None):
         self.scheduler = scheduler
         self.max_queue = int(max_queue)
         self.clock = clock
@@ -112,11 +126,17 @@ class ServeFrontend:
         # process cluster shares one tracer across replicas, so the
         # tracer's own default can't attribute them).
         self.replica = replica if replica is not None else scheduler.replica
+        self.reporter = reporter if reporter is not None \
+            else scheduler.reporter
         self._handles: Dict[int, RequestHandle] = {}
         self._next_id = 0
         # (timestamp, tokens emitted) per recent step — the decode
         # throughput window retry-after hints are derived from.
         self._step_times: List[tuple] = []
+        # Deterministic jitter stream for retry-after hints: seeded per
+        # frontend so replicas desynchronize each other's rejected
+        # clients, reproducible run-to-run (no wall-clock entropy).
+        self._jitter = random.Random(f"retry-jitter:{self.replica!r}")
 
     # -- submission ----------------------------------------------------
     def queue_depth(self) -> int:
@@ -145,7 +165,9 @@ class ServeFrontend:
     def _retry_after_hint(self) -> Optional[float]:
         """Seconds until a queue slot plausibly frees: the remaining
         tokens of the nearest-to-done live request, at the observed
-        per-request step rate (aggregate throughput / live requests)."""
+        per-request step rate (aggregate throughput / live requests),
+        jittered by a deterministic factor in [0.75, 1.25) so rejected
+        clients spread their retries instead of re-spiking together."""
         tput = self.decode_tokens_per_sec()
         if tput is None:
             return None
@@ -155,7 +177,46 @@ class ServeFrontend:
         nearest = min(
             max(1, r.max_new_tokens - len(r.generated)) for r in live
         )
-        return nearest * len(live) / tput
+        base = nearest * len(live) / tput
+        return base * (0.75 + 0.5 * self._jitter.random())
+
+    # -- priority shedding ---------------------------------------------
+    def sheddable_class(self, priority: int) -> Optional[int]:
+        """The class a shed would evict for an arrival of ``priority``:
+        the numerically largest waiting class STRICTLY below it, or
+        None when shedding can't help (everything waiting is at least
+        as important).  The router consults this to route an important
+        arrival at a full fleet toward the cheapest victim."""
+        worst = max(
+            (r.priority for r in self.scheduler.waiting), default=None
+        )
+        if worst is None or worst <= priority:
+            return None
+        return worst
+
+    def _shed_one(self, priority: int, now: float) -> bool:
+        """Evict the single worst waiting request (largest class, most
+        recently queued within it) iff strictly lower-class than
+        ``priority``.  The victim fails with a ``shed: ...`` error —
+        distinguishable from deadline/engine failures — and is counted
+        under ``serve/shed/<class>``."""
+        if self.sheddable_class(priority) is None:
+            return False
+        sched = self.scheduler
+        victim = max(
+            enumerate(sched.waiting), key=lambda iv: (iv[1].priority, iv[0])
+        )[1]
+        sched.waiting.remove(victim)
+        victim.state = RequestState.FAILED
+        victim.error = f"shed: overload (class {victim.priority})"
+        sched._finished[victim.request_id] = victim
+        h = self._handles.get(victim.request_id)
+        if h is not None and h.finished_at is None:
+            h.finished_at = now
+            self._close_trace(h)
+        if self.reporter is not None:
+            self.reporter.count(f"serve/shed/{victim.priority}", 1)
+        return True
 
     def submit(self, prompt, max_new_tokens: int,
                sampling: Optional[SamplingParams] = None,
@@ -165,6 +226,7 @@ class ServeFrontend:
                committed: Optional[List[int]] = None,
                trace=None,
                speculative: bool = True,
+               priority: int = 0,
                ) -> RequestHandle:
         """Enqueue one request; raises :class:`QueueFull` (with a
         ``retry_after_s`` hint once throughput is known) when the
@@ -181,12 +243,22 @@ class ServeFrontend:
         ``trace`` — parent trace context (a ``SpanCtx`` or its wire
         dict) when the request's ROOT span is owned elsewhere (the
         cluster router); with a tracer installed and no parent given,
-        this frontend mints the root here."""
-        if self.queue_depth() >= self.max_queue:
+        this frontend mints the root here.
+
+        ``priority`` — the request's shed class (0 = most important).
+        At capacity the arrival first tries to shed one strictly
+        lower-class waiting request; only when no such victim exists
+        does it see :class:`QueueFull` itself."""
+        priority = int(priority)
+        if self.queue_depth() >= self.max_queue and not self._shed_one(
+            priority, self.clock()
+        ):
             hint = self._retry_after_hint()
             msg = f"waiting queue at capacity ({self.max_queue})"
             if hint is not None:
                 msg += f"; retry after ~{hint:.3f}s"
+            if self.reporter is not None:
+                self.reporter.count(f"serve/rejected/{priority}", 1)
             raise QueueFull(msg, retry_after_s=hint)
         rid = self.reserve_id()
         req = Request(
@@ -197,7 +269,10 @@ class ServeFrontend:
             stop_token=stop_token,
             on_token=on_token,
             speculative=speculative,
+            priority=priority,
         )
+        if self.reporter is not None:
+            self.reporter.count(f"serve/admit/{priority}", 1)
         if committed:
             req.generated = list(map(int, committed))
         handle = RequestHandle(

@@ -68,6 +68,10 @@ class Request:
     prefix_hit_tokens: int = 0
     #: per-request opt-out for speculative decoding.
     speculative: bool = True
+    #: priority class: 0 is most important; larger = more sheddable.
+    #: The frontend's overload policy sheds the numerically largest
+    #: class first — scheduling order itself stays FCFS (Orca-style).
+    priority: int = 0
     #: host step index at which the first token appeared (TTFT proxy).
     first_token_step: Optional[int] = None
     #: trace context stage spans parent to (the request's ROOT — see

@@ -176,6 +176,20 @@ def _builtin_source_check(roots) -> List[str]:
     return problems
 
 
+def _chaos_grammar_check() -> List[str]:
+    """Round-trip the chaos grammar corpus (docs/fault_tolerance.md
+    schedules plus the serving replica=/at= coordinates) so a grammar
+    regression fails the same smoke that guards source hygiene."""
+    try:
+        from chainermn_tpu.elastic import chaos
+    except Exception as e:  # pragma: no cover - import rot is a finding
+        return [f"chaos-grammar: import failed: {e!r}"]
+    try:
+        return chaos.validate_grammar()
+    except Exception as e:
+        return [f"chaos-grammar: validator crashed: {e!r}"]
+
+
 def _self_check(repo_root: str) -> Tuple[List[str], str]:
     roots = [os.path.join(repo_root, d) for d in _REPO_SOURCE_DIRS]
     roots = [r for r in roots if os.path.exists(r)]
@@ -186,8 +200,9 @@ def _self_check(repo_root: str) -> Tuple[List[str], str]:
         )
         out = (proc.stdout + proc.stderr).strip()
         problems = out.splitlines() if proc.returncode else []
-        return problems, "ruff"
-    return _builtin_source_check(roots), "builtin-ast"
+        return problems + _chaos_grammar_check(), "ruff"
+    problems = _builtin_source_check(roots) + _chaos_grammar_check()
+    return problems, "builtin-ast"
 
 
 # ----------------------------------------------------------------------

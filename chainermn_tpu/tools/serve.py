@@ -24,6 +24,11 @@ Usage::
     # in-process smoke: 2 replicas, oracle parity check
     python -m chainermn_tpu.tools.serve --replicas 2 --verify
 
+    # heavy-tailed traffic + SLO-guarded autoscaling + timed chaos
+    python -m chainermn_tpu.tools.serve --replicas 2 --autoscale \
+        --traffic "rate=120,requests=32,abusive_frac=0.2" \
+        --chaos "kill:replica=1:at=0.5" --verify
+
     # same, with a Chrome/Perfetto trace of every request
     python -m chainermn_tpu.tools.serve --replicas 2 \
         --roles prefill,decode --prefill-threshold 8 \
@@ -149,14 +154,35 @@ def _oracle_streams(args, prompts) -> List[List[int]]:
     return [eng.generate(p, args.new_tokens) for p in prompts]
 
 
-def _install_tracer(args):
+def _parse_slo(text: Optional[str]):
+    """``"queue=2.0,decode=1.0"`` → SLOConfig, None when unset."""
+    if not text:
+        return None
+    from chainermn_tpu.observability.tracing import SLOConfig
+
+    targets = {}
+    for item in text.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if "=" not in item:
+            raise SystemExit(
+                f"--slo expects stage=seconds, got {item!r}"
+            )
+        k, v = item.split("=", 1)
+        targets[k.strip()] = float(v)
+    return SLOConfig(targets=targets)
+
+
+def _install_tracer(args, reporter=None, slo=None):
     """Install a process-wide tracer when --trace-out/--flight-dir asks
-    for one.  Returns (tracer, uninstall_cb); (None, noop) untraced."""
+    for one (or an SLO config needs burn-rate gauges).  Returns
+    (tracer, uninstall_cb); (None, noop) untraced."""
     import os
 
     from chainermn_tpu.observability import tracing
 
-    if not (args.trace_out or args.flight_dir):
+    if not (args.trace_out or args.flight_dir or slo is not None):
         return None, lambda: None
     flight = None
     if args.flight_dir:
@@ -164,7 +190,7 @@ def _install_tracer(args):
         flight = tracing.FlightRecorder(
             os.path.join(args.flight_dir, "flight_local.jsonl")
         )
-    tr = tracing.Tracer(flight=flight)
+    tr = tracing.Tracer(flight=flight, reporter=reporter, slo=slo)
     tracing.install(tr)
 
     def done():
@@ -194,6 +220,159 @@ def _export_trace(args, tr, extra: dict) -> None:
     extra["traces"] = len({
         r.get("trace") for r in recs if r.get("trace")
     })
+
+
+def run_local_traffic(args) -> int:
+    """``--traffic`` mode: replay a seeded heavy-tailed workload over
+    the fleet, optionally with the SLO-guarded autoscaler closing the
+    loop (``--autoscale``) and timed chaos faults (``--chaos``)."""
+    from chainermn_tpu.elastic.chaos import ChaosSchedule, TimedChaos
+    from chainermn_tpu.observability.reporter import Reporter
+    from chainermn_tpu.serving import workload
+    from chainermn_tpu.serving.cluster import (
+        Autoscaler,
+        AutoscalerConfig,
+        HeartbeatMonitor,
+        Replica,
+        ReplicaRouter,
+        ThreadedClusterDriver,
+    )
+
+    factory = _engine_factory(args)
+    roles = _parse_roles(args.roles, args.replicas)
+    reporter = Reporter()
+    tr, tr_done = _install_tracer(
+        args, reporter=reporter, slo=_parse_slo(args.slo)
+    )
+    spec = workload.TrafficSpec.parse(args.traffic)
+    if spec.vocab >= args.vocab:
+        raise SystemExit(
+            f"--traffic vocab={spec.vocab} must stay below the model's "
+            f"--vocab {args.vocab}"
+        )
+
+    def replica_factory(rid):
+        return Replica(
+            rid, factory(), role="both", reporter=reporter,
+            watermark_blocks=args.watermark, max_queue=args.max_queue,
+            spec_tokens=args.spec_tokens,
+        )
+
+    replicas = [
+        Replica(
+            i, factory(), role=roles[i], reporter=reporter,
+            watermark_blocks=args.watermark, max_queue=args.max_queue,
+            spec_tokens=args.spec_tokens,
+        )
+        for i in range(args.replicas)
+    ]
+    router = ReplicaRouter(
+        replicas,
+        prefill_threshold=args.prefill_threshold,
+        reporter=reporter,
+        health=HeartbeatMonitor(
+            [r.replica_id for r in replicas], miss_after_s=30.0
+        ),
+    )
+    autoscaler = None
+    if args.autoscale:
+        autoscaler = Autoscaler(
+            router, replica_factory,
+            AutoscalerConfig(
+                min_replicas=args.replicas,
+                max_replicas=args.max_replicas or args.replicas + 2,
+            ),
+            reporter=reporter,
+        )
+    chaos = None
+    if args.chaos:
+        chaos = TimedChaos(ChaosSchedule.parse(args.chaos))
+
+    arrivals = workload.generate(spec)
+
+    def fire(fault) -> None:
+        rid = fault.replica
+        if rid is None:
+            alive = [r.replica_id for r in router.replicas.values()
+                     if r.alive]
+            rid = alive[0] if alive else None
+        if rid is None or rid not in router.replicas:
+            return
+        if fault.kind == "kill":
+            router.fail_replica(rid, reason="chaos kill")
+        elif fault.kind == "term":
+            router.drain(rid)
+
+    t0 = time.perf_counter()
+    with ThreadedClusterDriver(router) as drv:
+        def pump():
+            drv.ensure_threads()
+            router.step(drive_replicas=False)
+            if autoscaler is not None:
+                autoscaler.step()
+            if chaos is not None:
+                for f in chaos.due():
+                    fire(f)
+
+        report = workload.replay(
+            arrivals,
+            lambda a: router.submit(
+                list(a.prompt), a.max_new_tokens,
+                timeout_s=args.timeout_s, priority=a.priority,
+            ),
+            pump=pump, drain_timeout_s=args.timeout_s,
+        )
+        drv.run_until_idle(timeout_s=args.timeout_s)
+    wall = time.perf_counter() - t0
+
+    traffic = workload.summarize(report)
+    traffic["spec"] = spec.format()
+    if autoscaler is not None:
+        traffic["autoscaler_events"] = [
+            {k: (round(v, 3) if isinstance(v, float) else v)
+             for k, v in ev.items() if k != "t"}
+            for ev in autoscaler.events
+        ]
+        traffic["replicas_final"] = len(router.replicas)
+    gauges = reporter.summary().get("gauges", {})
+    traffic["burn_rates"] = {
+        k.split("/", 2)[2]: round(float(v["value"]), 4)
+        for k, v in gauges.items()
+        if k.startswith("slo/burn_rate/")
+    }
+    counters = reporter.summary().get("counters", {})
+    traffic["shed_counters"] = {
+        k: v for k, v in sorted(counters.items())
+        if k.startswith(("serve/shed/", "serve/admit/",
+                         "serve/rejected/"))
+    }
+
+    finished = [o for o in report.outcomes if o.finished]
+    results = {
+        o.arrival.index: {
+            "tokens": list(o.handle.tokens), "status": o.handle.status,
+            "failovers": o.handle.failovers,
+        }
+        for o in report.outcomes if o.handle is not None
+    }
+    extra = {"roles": roles, "traffic": traffic}
+    if args.verify:
+        eng = _engine_factory(args)()
+        mismatches = [
+            o.arrival.index for o in finished
+            if list(o.handle.tokens) != eng.generate(
+                list(o.arrival.prompt), o.arrival.max_new_tokens
+            )
+        ]
+        extra["parity"] = "ok" if not mismatches else "FAIL"
+        extra["parity_mismatches"] = mismatches
+    if tr is not None:
+        _export_trace(args, tr, extra)
+    tr_done()
+    print(json.dumps(_report(args, results, wall, extra)))
+    if args.verify and extra["parity"] != "ok":
+        return 1
+    return 0
 
 
 def run_local(args) -> int:
@@ -392,6 +571,26 @@ def main(argv=None) -> int:
                     help="replay through a sequential oracle and fail "
                          "unless streams are bit-identical")
     ap.add_argument("--timeout-s", type=float, default=120.0)
+    # autoscaling + generated traffic (local role only)
+    ap.add_argument("--traffic", default=None, metavar="SPEC",
+                    help="replay a seeded heavy-tailed workload instead "
+                         "of the fixed prompt sweep; SPEC is "
+                         "'key=value,...' (or 'default'), see "
+                         "serving.workload.TrafficSpec")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="run the SLO-guarded autoscaler during "
+                         "--traffic replay: spawn on pressure, "
+                         "drain+migrate+retire on idleness")
+    ap.add_argument("--max-replicas", type=int, default=None,
+                    help="autoscaler ceiling (default: --replicas + 2)")
+    ap.add_argument("--chaos", default=None, metavar="SPEC",
+                    help="timed fault schedule for --traffic, e.g. "
+                         "'kill:replica=1:at=0.5' (seconds since "
+                         "replay start; see elastic.chaos)")
+    ap.add_argument("--slo", default=None, metavar="TARGETS",
+                    help="per-stage latency targets 'stage=seconds,...' "
+                         "(e.g. 'queue=5,decode=2'); installs a tracer "
+                         "so slo/burn_rate/<stage> gauges populate")
     # observability
     ap.add_argument("--trace-out", default=None,
                     help="write a Chrome/Perfetto trace JSON of every "
@@ -424,6 +623,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.role == "local":
+        if args.traffic:
+            return run_local_traffic(args)
         return run_local(args)
     return run_multiprocess(args)
 

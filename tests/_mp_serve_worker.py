@@ -22,6 +22,13 @@ With a ``flight_dir`` argument every rank records its trace spans to a
 crash-surviving flight file (``flight_<rank>.jsonl``) — the SIGKILLed
 rank's stage spans survive on disk and the host test stitches them into
 the router's root spans for the failover postmortem.
+
+With the literal argument ``traffic`` instead of a flight dir, the
+router drives a seeded heavy-tailed workload (serving.workload — MMPP
+bursts, Zipf shared prefixes, mixed length buckets) under an SLO-wired
+tracer: the SIGKILL lands at peak generated load, and rank 0
+additionally asserts every ``slo/burn_rate/*`` gauge stayed below 1.0
+before printing ``SERVE_TRAFFIC_OK burn_max=<x>``.
 """
 
 import os
@@ -32,8 +39,9 @@ def main():
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     kill_after = int(sys.argv[4])
     flight_dir = sys.argv[5] if len(sys.argv) > 5 else None
+    traffic = flight_dir == "traffic"
     flight_path = None
-    if flight_dir:
+    if flight_dir and not traffic:
         flight_path = os.path.join(flight_dir, f"flight_{pid}.jsonl")
 
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
@@ -67,42 +75,81 @@ def main():
             block_size=4, n_blocks=64, max_len=64, max_batch=2,
         ))
 
-    rng = np.random.default_rng(13)
-    prompts = [
-        [int(t) for t in rng.integers(0, 32, size=int(n))]
-        for n in rng.integers(4, 11, size=6)
-    ]
-    # Half the fleet's traffic shares a 2-page prefix: the kill lands
-    # while refcounted/index-registered pages are live in the victim's
-    # and survivor's pools, and the survivor's clean-stop
-    # assert_consistent proves no page leaked or double-freed.
-    shared = [int(t) for t in rng.integers(0, 32, size=8)]
-    prompts = [shared + p if i % 2 == 0 else p
-               for i, p in enumerate(prompts)]
-    NEW = 8
+    if traffic:
+        # Heavy-tailed generated load: the kill lands mid-burst, with
+        # Zipf-shared prefix pages live in both replica pools.  Length
+        # buckets are capped so prompt + output fits max_len=64.
+        from chainermn_tpu.serving import TrafficSpec, workload
+
+        spec = TrafficSpec(
+            seed=5, requests=10, rate=200.0, burst=6.0, p_burst=0.3,
+            prefix_len=8, templates=4,
+            prompt_buckets=((4, 12, 0.7), (14, 20, 0.3)),
+            output_buckets=((4, 8, 0.8), (10, 12, 0.2)),
+            vocab=32,
+        )
+        arrivals = workload.generate(spec)
+        prompts = [list(a.prompt) for a in arrivals]
+        news = [a.max_new_tokens for a in arrivals]
+    else:
+        rng = np.random.default_rng(13)
+        prompts = [
+            [int(t) for t in rng.integers(0, 32, size=int(n))]
+            for n in rng.integers(4, 11, size=6)
+        ]
+        # Half the fleet's traffic shares a 2-page prefix: the kill
+        # lands while refcounted/index-registered pages are live in the
+        # victim's and survivor's pools, and the survivor's clean-stop
+        # assert_consistent proves no page leaked or double-freed.
+        shared = [int(t) for t in rng.integers(0, 32, size=8)]
+        prompts = [shared + p if i % 2 == 0 else p
+                   for i, p in enumerate(prompts)]
+        news = [8] * len(prompts)
 
     if pid == 0:
         requests = [
-            {"prompt": p, "max_new_tokens": NEW} for p in prompts
+            {"prompt": p, "max_new_tokens": n}
+            for p, n in zip(prompts, news)
         ]
+        reporter = slo = None
+        if traffic:
+            from chainermn_tpu.observability.reporter import Reporter
+            from chainermn_tpu.observability.tracing import SLOConfig
+
+            reporter = Reporter()
+            # Router-visible stages; lenient targets sized for CPU
+            # compile stalls — burn < 1.0 is the green-SLO assertion.
+            slo = SLOConfig(targets={"request": 120.0,
+                                     "placement": 60.0})
         # miss_after_s must tolerate a replica stalled in a cold jit
         # compile (seconds on CPU); REAL deaths are detected much
         # faster via socket EOF -> PeerGone on the event edge.
         results = service.run_router(
             nproc, requests, miss_after_s=30.0, timeout_s=180.0,
-            flight_path=flight_path,
+            flight_path=flight_path, reporter=reporter, slo=slo,
         )
         try:
             oracle = engine_factory()
             failovers = 0
-            for gid, p in enumerate(prompts):
+            for gid, (p, n) in enumerate(zip(prompts, news)):
                 rr = results[gid]
                 assert rr["status"] == "finished", (gid, rr)
-                want = oracle.generate(p, NEW)
+                want = oracle.generate(p, n)
                 assert rr["tokens"] == want, (gid, rr["tokens"], want)
                 failovers += rr["failovers"]
             if kill_after > 0:
                 assert failovers > 0, "nobody failed over despite kill"
+            if traffic:
+                gauges = reporter.summary()["gauges"]
+                burns = {
+                    k.split("/", 2)[2]: g["value"]
+                    for k, g in gauges.items()
+                    if k.startswith("slo/burn_rate/")
+                }
+                assert burns, "no SLO burn gauges populated"
+                burn_max = max(burns.values())
+                assert burn_max < 1.0, f"SLO burned red: {burns}"
+                print(f"SERVE_TRAFFIC_OK burn_max={burn_max:.4f}")
         except BaseException:
             import traceback
 

@@ -356,6 +356,35 @@ def test_serving_cluster_clean_run_no_kill():
     assert "SERVE_REPLICA_OK 2" in outs[2]
 
 
+def test_serving_traffic_soak_kill_at_peak_load():
+    """The chaos-under-load soak: the fleet serves a seeded
+    heavy-tailed workload (MMPP bursts, Zipf shared prefixes, mixed
+    length buckets) with the router's tracer wired to an SLO config,
+    and the highest rank SIGKILLs itself at peak generated load with
+    live sequences in its pool.  Three properties must hold at once:
+
+    * every stream finishes BIT-IDENTICAL to the sequential
+      single-engine oracle (failover replays committed prefixes);
+    * at least one stream actually crossed the kill (failovers > 0);
+    * every ``slo/burn_rate/*`` gauge stays below 1.0 — the cluster
+      degraded gracefully instead of burning its error budget.
+    """
+    import re
+
+    procs, outs = _launch(_SERVE_WORKER, 3, "6", "traffic",
+                          n_devices=1, timeout=420)
+    codes = [p.returncode for p in procs]
+    assert codes[2] == -9, f"rank 2 should die by SIGKILL: {codes}\n" \
+        + "\n".join(outs)
+    assert codes[0] == 0, f"router failed:\n{outs[0]}"
+    assert "SERVE_SOAK_OK" in outs[0], outs[0]
+    m = re.search(r"SERVE_TRAFFIC_OK burn_max=([0-9.]+)", outs[0])
+    assert m, outs[0]
+    assert float(m.group(1)) < 1.0
+    assert codes[1] == 0, f"survivor replica failed:\n{outs[1]}"
+    assert "SERVE_REPLICA_OK 1" in outs[1], outs[1]
+
+
 # ---------------------------------------------------------------------------
 # Elastic supervisor soaks: the WHOLE fault-tolerance loop over real
 # process boundaries — heartbeat-deadline detection, bounded teardown,
