@@ -632,6 +632,12 @@ def bench_serve(comm, args):
                 best["tokens_per_sec"]
                 / max(base["tokens_per_sec"], 1e-9), 3),
         }
+    if args.serve_draft:
+        out["draft_ab"] = _serve_draft_ab(args, model, params, prompts,
+                                          best)
+    if args.serve_prefill_chunk > 0:
+        out["prefill_chunk"] = _serve_prefill_chunk_ab(
+            args, model, params, best)
     if args.kv_dtype:
         from chainermn_tpu.communicators.quant import canonical_kv_dtype
 
@@ -647,7 +653,8 @@ def bench_serve(comm, args):
 
 
 def _serve_sweep_point(args, model, params, prompts, bs, *,
-                       spec_tokens, prefix_cache=True, kv_dtype=None):
+                       spec_tokens, prefix_cache=True, kv_dtype=None,
+                       draft=None, draft_layers=None):
     """One measured serving run: fresh engine at decode batch ``bs``,
     all ``prompts`` through the queue frontend, tokens/sec plus
     per-token latency percentiles and the prefix/speculation counters.
@@ -669,6 +676,8 @@ def _serve_sweep_point(args, model, params, prompts, bs, *,
         max_batch=bs,
         prefix_cache=prefix_cache,
         kv_dtype=kv_dtype,
+        draft=draft,
+        draft_layers=draft_layers,
     )
     engine = InferenceEngine(model, params, ecfg)
     sched = ContinuousBatchingScheduler(engine, spec_tokens=spec_tokens)
@@ -740,10 +749,128 @@ def _serve_sweep_point(args, model, params, prompts, bs, *,
     if sched._spec_rows:
         row["spec_accept_len"] = round(
             sched._spec_emitted / sched._spec_rows, 3)
+    if draft is not None:
+        row["draft_source"] = engine.draft_source
     if "kv_quant_err" in st:
         row["kv_dtype"] = st["kv_dtype"]
         row["kv_quant_err"] = st["kv_quant_err"]
     return row
+
+
+def _serve_draft_ab(args, model, params, prompts, best):
+    """--serve-draft: both speculative draft sources at the winning
+    batch size, identical traffic.  Exact-match acceptance pins the
+    streams identical across the pair; what differs is the accept
+    length (tokens banked per verify row) and the wall clock — the
+    draft choice is a pure throughput decision, and this A/B is the
+    measurement behind the tuned ``draft`` cache entry."""
+    spec = max(1, args.serve_spec_tokens)
+    bs = best["batch_size"]
+    rows = []
+    for src in ("ngram", "model"):
+        row = _serve_sweep_point(
+            args, model, params, prompts, bs, spec_tokens=spec,
+            draft=src, draft_layers=args.serve_draft_layers,
+        )
+        rows.append(row)
+    by = {r["draft_source"]: r for r in rows}
+    return {
+        "spec_tokens": spec,
+        "batch_size": bs,
+        "rows": rows,
+        "accept_len": {
+            s: by[s].get("spec_accept_len") for s in by
+        },
+        "tokens_per_sec": {
+            s: by[s]["tokens_per_sec"] for s in by
+        },
+    }
+
+
+def _serve_prefill_chunk_ab(args, model, params, best):
+    """--serve-prefill-chunk N: the decode-p99 story chunked prefill
+    exists for.  Short requests stream while one near-budget prompt
+    arrives mid-flight; monolithic prefill charges the whole prompt to
+    a single scheduler step (every streaming request stalls behind it),
+    chunked prefill slices it between decode steps.  Reported: the
+    short requests' token-gap p99/max, sliced vs monolithic, same
+    traffic (streams identical either way — chunking only re-times the
+    prefill work)."""
+    from chainermn_tpu.serving import (
+        ContinuousBatchingScheduler,
+        EngineConfig,
+        InferenceEngine,
+        SamplingParams,
+        ServeFrontend,
+    )
+
+    N = args.serve_new_tokens
+    n_short = max(2, best["batch_size"])
+    rng = np.random.RandomState(7)
+    long_len = min(args.serve_max_len - N - 1,
+                   args.serve_prompt_len * 8)
+    shorts = [
+        rng.randint(0, args.lm_vocab,
+                    size=args.serve_prompt_len).tolist()
+        for _ in range(n_short)
+    ]
+    long_prompt = rng.randint(0, args.lm_vocab, size=long_len).tolist()
+
+    def one(chunk):
+        ecfg = EngineConfig(
+            block_size=args.serve_block_size,
+            n_blocks=args.serve_blocks,
+            max_len=args.serve_max_len,
+            max_batch=n_short + 1,
+            prefix_cache=False,
+            prefill_chunk=chunk,
+        )
+        engine = InferenceEngine(model, params, ecfg)
+        sched = ContinuousBatchingScheduler(engine)
+        fe = ServeFrontend(sched, max_queue=n_short + 2)
+
+        def workload():
+            stamps = {}
+
+            def on_token(rid, tok, _s=stamps):
+                _s.setdefault(rid, []).append(time.perf_counter())
+
+            for p in shorts:
+                fe.submit(p, N, sampling=SamplingParams(),
+                          on_token=on_token)
+            for _ in range(3):  # decode cadence established first
+                fe.step()
+            fe.submit(long_prompt, 4, sampling=SamplingParams())
+            fe.run_until_idle()
+            gaps = []
+            for ts in stamps.values():
+                gaps.extend(b - a for a, b in zip(ts, ts[1:]))
+            gaps.sort()
+            return gaps
+
+        workload()  # warm: compile every bucket this shape touches
+        gaps = workload()
+        if not gaps:
+            return {"p99_ms": None, "max_ms": None}
+        p99 = gaps[min(len(gaps) - 1, int(0.99 * len(gaps)))]
+        return {
+            "p99_ms": round(p99 * 1e3, 3),
+            "max_ms": round(gaps[-1] * 1e3, 3),
+        }
+
+    chunked = one(args.serve_prefill_chunk)
+    mono = one(0)
+    return {
+        "chunk_tokens": args.serve_prefill_chunk,
+        "long_prompt_len": long_len,
+        "short_requests": n_short,
+        "chunked": chunked,
+        "monolithic": mono,
+        "p99_improvement": (
+            round(mono["p99_ms"] / chunked["p99_ms"], 3)
+            if chunked["p99_ms"] and mono["p99_ms"] else None
+        ),
+    }
 
 
 def _serve_kv_ab(args, model, params, prompts, best, kv_dtype):
@@ -1405,6 +1532,22 @@ def main(argv=None):
                     help="speculative draft length for the serve "
                          "sweep's spec-ON column (OFF column always "
                          "runs alongside)")
+    ap.add_argument("--serve-draft", action="store_true",
+                    help="A/B the speculative draft sources at the "
+                         "winning batch size: n-gram prompt lookup vs "
+                         "the layer-truncated self-draft model, same "
+                         "traffic (streams identical by exact-match "
+                         "acceptance; only accept length and wall "
+                         "clock differ)")
+    ap.add_argument("--serve-draft-layers", type=int, default=None,
+                    help="self-draft depth for --serve-draft "
+                         "(default: half the target's layers)")
+    ap.add_argument("--serve-prefill-chunk", type=int, default=0,
+                    help="when > 0, prove chunked prefill: a "
+                         "long-prompt arrival mid-decode, short "
+                         "requests' token-gap p99 with prompts "
+                         "sliced at this many tokens vs monolithic "
+                         "prefill")
     ap.add_argument("--comm-dtype", default=None,
                     choices=["none", "int8", "fp8"],
                     help="quantized gradient wire for the train benches "

@@ -380,6 +380,37 @@ def fixture_serving_decode() -> dict:
     )
 
 
+def fixture_draft_verify() -> dict:
+    """The speculative draft model's jitted proposal step — the
+    layer-truncated self-draft forward the serving engine runs per
+    draft token (``serving/spec.py::DraftModel``).  A CLEAN fixture
+    (``expect=None``) completing the speculative-decoding trio with
+    ``serving_verify``: the draft is a throughput hint computed from a
+    strict SUBSET of the target's params on the request's own device,
+    so like the decode and verify planes it must stay collective-free —
+    a draft that reaches across devices would put cluster topology on
+    the per-token latency path for tokens that may all be rejected."""
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    geom = dict(vocab=32, d_model=16, n_heads=2, d_ff=32, n_layers=1,
+                max_len=16)
+    model = TransformerLM(**geom)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    length = jnp.asarray(4, jnp.int32)
+
+    def draft_step(params, tokens, length):
+        logits = model.apply({"params": params}, tokens)
+        row = logits[0, jnp.maximum(length - 1, 0)]
+        return jnp.argmax(row.astype(jnp.float32)).astype(jnp.int32)
+
+    return dict(
+        target="draft_verify", expect=None,
+        fn=jax.jit(draft_step),
+        args=(params, tokens, length), kwargs={}, comm=None,
+    )
+
+
 FIXTURES: Dict[str, Callable[[], dict]] = {
     "r001": fixture_r001,
     "r002": fixture_r002,
@@ -392,6 +423,7 @@ FIXTURES: Dict[str, Callable[[], dict]] = {
     "overlap_async_pairs": fixture_overlap_async_pairs,
     "serving_decode": fixture_serving_decode,
     "serving_verify": fixture_serving_verify,
+    "draft_verify": fixture_draft_verify,
 }
 
 

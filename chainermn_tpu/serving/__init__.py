@@ -16,8 +16,9 @@ exercises the repo's own model (:class:`~chainermn_tpu.models.transformer
   padding buckets (bounded recompiles), the paged-attention data plane
   from :mod:`~chainermn_tpu.ops.decode_attention` (CPU-safe, tuned
   gather chunks on TPU), host-side deterministic sampling;
-* :mod:`~chainermn_tpu.serving.spec` — n-gram prompt-lookup drafting
-  for speculative decoding (model-free, deterministic per request);
+* :mod:`~chainermn_tpu.serving.spec` — draft proposal sources for
+  speculative decoding: n-gram prompt lookup (model-free) and the
+  layer-truncated self-draft model (both deterministic per request);
 * :mod:`~chainermn_tpu.serving.scheduler` — Orca-style iteration-level
   continuous batching: FCFS admission with a free-page watermark
   (prefix hits discounted), one batched decode/verify per step,
@@ -51,11 +52,16 @@ from chainermn_tpu.serving.kv_cache import (  # noqa: F401
     CacheStats,
     OutOfBlocks,
     PagedKVCache,
+    prefix_digest,
+    prompt_digests,
 )
 from chainermn_tpu.serving.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler,
     Request,
     RequestState,
+)
+from chainermn_tpu.serving.spec import (  # noqa: F401
+    DraftModel,
 )
 from chainermn_tpu.serving.workload import (  # noqa: F401
     Arrival,

@@ -15,6 +15,10 @@ this package turns N of them into a routed fleet:
 * :mod:`migration` — serialize a live sequence's KV pages + block-table
   slice, move them (in-process or over the typed socket plane), restore
   with :meth:`PagedKVCache.assert_consistent` holding;
+* :mod:`prefix_gossip` — the cluster-global prefix index: replicas
+  gossip content-addressed digests of their prefix-index keys on load
+  beats (versioned anti-entropy), so routers score *remote* prefix
+  hits and same-template traffic converges on the warm replica;
 * :mod:`health` — heartbeat liveness and watermark-driven scale/drain
   signals as Reporter gauges, plus the hysteresis filter debouncing
   them;
@@ -48,6 +52,9 @@ from chainermn_tpu.serving.cluster.migration import (  # noqa: F401
     recv_snapshot,
     restore_sequence,
     send_snapshot,
+)
+from chainermn_tpu.serving.cluster.prefix_gossip import (  # noqa: F401
+    PrefixGossip,
 )
 from chainermn_tpu.serving.cluster.replica import (  # noqa: F401
     Replica,

@@ -25,10 +25,11 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Callable, Deque, List, Optional
+from typing import Callable, Deque, List, Optional, Tuple
 
 import dataclasses
 
+from chainermn_tpu.serving.cluster.prefix_gossip import MAX_GOSSIP_DIGESTS
 from chainermn_tpu.serving.cluster.disagg import (
     PrefillJob,
     PrefillResult,
@@ -63,6 +64,16 @@ class ReplicaLoad:
     min_slack_s: Optional[float] = None
     #: observed decode throughput (tokens/s); None before warm.
     tokens_per_sec: Optional[float] = None
+    #: KV page size in tokens — lets a router translate a prompt into
+    #: page digests without knowing the replica's engine config.  0 in
+    #: snapshots from peers predating the gossip fields (wire compat).
+    block_size: int = 0
+    #: prefix-index anti-entropy stamp (kv.index_version at snapshot
+    #: time) — receivers apply strictly-newer digest sets only.
+    prefix_version: int = 0
+    #: content digests of the replica's registered prefix-index keys
+    #: (kv_cache.prefix_digest), capped at MAX_GOSSIP_DIGESTS.
+    prefix_digests: Tuple[int, ...] = ()
 
     @property
     def free_frac(self) -> float:
@@ -81,6 +92,9 @@ class ReplicaLoad:
 
     @classmethod
     def from_dict(cls, d: dict) -> "ReplicaLoad":
+        d = dict(d)
+        if d.get("prefix_digests") is not None:
+            d["prefix_digests"] = tuple(d["prefix_digests"])
         return cls(**d)
 
 
@@ -163,6 +177,11 @@ class Replica:
             max_batch=self.engine.max_batch,
             min_slack_s=min(slacks) if slacks else None,
             tokens_per_sec=self.frontend.decode_tokens_per_sec(),
+            block_size=st.block_size,
+            prefix_version=self.engine.kv.index_version,
+            prefix_digests=tuple(self.engine.kv.prefix_digests(
+                limit=MAX_GOSSIP_DIGESTS
+            )),
         )
 
     # -- stepping (worker-side; callers hold self.lock) ----------------

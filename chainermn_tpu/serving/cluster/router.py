@@ -49,10 +49,11 @@ from chainermn_tpu.serving.cluster.migration import (
     extract_sequence,
     restore_sequence,
 )
+from chainermn_tpu.serving.cluster.prefix_gossip import PrefixGossip
 from chainermn_tpu.serving.cluster.replica import Replica, ReplicaLoad
 from chainermn_tpu.serving.engine import SamplingParams
 from chainermn_tpu.serving.frontend import QueueFull
-from chainermn_tpu.serving.kv_cache import OutOfBlocks
+from chainermn_tpu.serving.kv_cache import OutOfBlocks, prompt_digests
 from chainermn_tpu.serving.scheduler import Request
 
 
@@ -139,6 +140,11 @@ class ReplicaRouter:
         #: multiple of the fleet median (see tracing.detect_stragglers).
         self.straggler_k = float(straggler_k)
         self._steps = 0
+        #: cluster-global prefix index: per-replica digest views folded
+        #: from load snapshots at step boundaries (beat cadence), so
+        #: placement sees remote prefix hits even when the direct probe
+        #: below is unavailable or the view is one beat stale.
+        self.gossip = PrefixGossip()
 
     # -- scoring -------------------------------------------------------
     @staticmethod
@@ -187,14 +193,27 @@ class ReplicaRouter:
         the shared pages are discounted from the admission need, and the
         hit fraction feeds the placement score — so duplicate-prefix
         traffic sticks to the replica that already holds those pages.
+        The probe is the max of the direct (in-process) index lookup and
+        the gossiped digest view, so a hit is seen even when the local
+        view lags a beat; staleness is safe because the chosen replica's
+        admission re-probes its own index (a phantom hit degrades to a
+        full prefill, never a wrong stream — the optimistic need
+        discount below shares that property, backed by preemption).
         """
         now = self.clock() if now is None else now
         best, best_key = None, None
+        digests_by_bs: Dict[int, List[int]] = {}
         for rep in self.replicas.values():
             load = rep.load(now)
             hit_pages = 0
             if prompt_tokens:
                 hit_pages = len(rep.engine.kv.match_prefix(prompt_tokens))
+                bs = rep.engine.kv.block_size
+                if bs not in digests_by_bs:
+                    digests_by_bs[bs] = prompt_digests(prompt_tokens, bs)
+                hit_pages = max(hit_pages, self.gossip.hit_pages(
+                    digests_by_bs[bs], rep.replica_id
+                ))
             need = rep.engine.kv.blocks_for(prompt_len + 1) - hit_pages
             if not self._admissible(load, need, rep.scheduler.watermark):
                 continue
@@ -473,6 +492,7 @@ class ReplicaRouter:
             rep.handoffs.clear()
         if self.health is not None:
             self.health.mark_dead(replica_id)
+        self.gossip.forget(replica_id)
         moved = 0
         # 1. Streaming requests placed on the dead replica: re-place
         #    with their committed prefix.
@@ -532,6 +552,17 @@ class ReplicaRouter:
                     emitted += rep.step()
                 if self.health is not None:
                     self.health.beat(rep.replica_id, now)
+        # Anti-entropy beat: fold every live replica's digest snapshot
+        # into the gossip view (in-process the "wire" is a method call,
+        # but the freshness semantics match the service loop: the view
+        # advances at step boundaries, placement reads it in between).
+        for rep in self.replicas.values():
+            if rep.alive:
+                kv = rep.engine.kv
+                self.gossip.observe(
+                    rep.replica_id, kv.index_version,
+                    kv.prefix_digests(),
+                )
         self._collect_handoffs()
         self._place_handoffs()
         self._sync(now)
@@ -834,6 +865,7 @@ class ReplicaRouter:
                 return False
             rep.alive = False
         del self.replicas[replica_id]
+        self.gossip.forget(replica_id)
         if self.health is not None:
             self.health.forget(replica_id)
         if self.reporter is not None:

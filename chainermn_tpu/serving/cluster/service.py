@@ -52,10 +52,12 @@ from typing import Dict, List, Optional
 from chainermn_tpu.communicators.kvtransport import ObjectPlane, PeerGone
 from chainermn_tpu.observability import tracing as _tracing
 from chainermn_tpu.serving.cluster.health import HeartbeatMonitor
+from chainermn_tpu.serving.cluster.prefix_gossip import PrefixGossip
 from chainermn_tpu.serving.cluster.replica import Replica, ReplicaLoad
 from chainermn_tpu.serving.cluster.router import ReplicaRouter
 from chainermn_tpu.serving.engine import SamplingParams
 from chainermn_tpu.serving.frontend import QueueFull
+from chainermn_tpu.serving.kv_cache import prompt_digests
 
 CMD = 1
 EVT = 2
@@ -81,7 +83,8 @@ def run_replica(rank: int, size: int, engine_factory,
                 heartbeat_s: float = 0.2,
                 kill_after_tokens: Optional[int] = None,
                 plane: Optional[ObjectPlane] = None,
-                flight_path: Optional[str] = None) -> dict:
+                flight_path: Optional[str] = None,
+                spec_tokens: int = 0) -> dict:
     """Serve as replica ``rank`` until the router says stop (or the
     router's edge dies).  ``engine_factory()`` builds the
     InferenceEngine (model + params + config) — construction is the
@@ -103,6 +106,7 @@ def run_replica(rank: int, size: int, engine_factory,
         return _run_replica_inner(
             rank, size, engine_factory, role, max_queue,
             watermark_blocks, heartbeat_s, kill_after_tokens, plane,
+            spec_tokens,
         )
     finally:
         if tr is not None:
@@ -112,7 +116,7 @@ def run_replica(rank: int, size: int, engine_factory,
 
 def _run_replica_inner(rank, size, engine_factory, role, max_queue,
                        watermark_blocks, heartbeat_s,
-                       kill_after_tokens, plane) -> dict:
+                       kill_after_tokens, plane, spec_tokens=0) -> dict:
     import os
     import signal
 
@@ -127,6 +131,7 @@ def _run_replica_inner(rank, size, engine_factory, role, max_queue,
     rep = Replica(
         rank, engine_factory(), role=role,
         watermark_blocks=watermark_blocks, max_queue=max_queue,
+        spec_tokens=spec_tokens,
     )
     outbox: List[tuple] = []
     gid_of_local: Dict[int, int] = {}
@@ -382,6 +387,11 @@ def _run_router_inner(size, requests, prefill_threshold, roles,
     loads: Dict[int, ReplicaLoad] = {}
     assigned: Dict[int, set] = {r: set() for r in replica_ranks}
     health = HeartbeatMonitor(replica_ranks, miss_after_s=miss_after_s)
+    # Cluster-global prefix index: digest snapshots ride the load beats
+    # (versioned anti-entropy — see cluster/prefix_gossip.py), so
+    # pick_replica below can score a prompt's prefix affinity for
+    # replicas this router has never sent it to.
+    gossip = PrefixGossip()
     reqs: Dict[int, _RemoteRequest] = {}
     pending: List[_RemoteRequest] = []
     prefilling: Dict[int, int] = {}  # gid -> prefill replica
@@ -392,6 +402,11 @@ def _run_router_inner(size, requests, prefill_threshold, roles,
         spec.setdefault("sampling", {})
         spec.setdefault("stop_token", None)
         spec.setdefault("timeout_s", None)
+        # Optional placement gate: hold this request back until every
+        # listed gid has finished (deterministic multi-wave workloads —
+        # the gossip soak's second wave arrives only after the first
+        # wave's pages are registered and gossiped).
+        spec.setdefault("after_gids", None)
         rr = _RemoteRequest(gid, spec)
         if tr is not None:
             rr.trace = tr.begin(
@@ -420,6 +435,8 @@ def _run_router_inner(size, requests, prefill_threshold, roles,
 
     def pick_replica(rr: _RemoteRequest) -> Optional[int]:
         best, best_key = None, None
+        prompt = rr.spec["prompt"]
+        digests_by_bs: Dict[int, list] = {}
         for r in sorted(alive):
             if roles.get(r) == "prefill":
                 continue
@@ -427,7 +444,22 @@ def _run_router_inner(size, requests, prefill_threshold, roles,
             if ld is not None:
                 if ld.queue_depth >= ld.max_queue:
                     continue
-                key = (ReplicaRouter.score(ld), -r)
+                # Remote prefix affinity from the gossiped digest view:
+                # the same 1.5x term the in-process router applies, so
+                # same-template traffic converges on the replica already
+                # warm for it.  Stale gossip is safe — the replica's own
+                # admission re-probes its local index, and a phantom hit
+                # degrades to a full local prefill, never a wrong stream.
+                prefix_frac = 0.0
+                if prompt and not rr.tokens and ld.block_size > 0:
+                    bs = ld.block_size
+                    if bs not in digests_by_bs:
+                        digests_by_bs[bs] = prompt_digests(prompt, bs)
+                    hit = gossip.hit_pages(digests_by_bs[bs], r)
+                    prefix_frac = min(
+                        1.0, hit * bs / max(1, len(prompt))
+                    )
+                key = (ReplicaRouter.score(ld, prefix_frac), -r)
             else:
                 key = (0.0, -r)  # cold replica: neutral score
             if best_key is None or key > best_key:
@@ -464,6 +496,7 @@ def _run_router_inner(size, requests, prefill_threshold, roles,
             return
         alive.discard(rank)
         health.mark_dead(rank)
+        gossip.forget(rank)
         for gid in sorted(assigned.pop(rank, set())):
             rr = reqs[gid]
             if rr.done:
@@ -574,6 +607,8 @@ def _run_router_inner(size, requests, prefill_threshold, roles,
             elif kind == "load":
                 loads[rank] = ReplicaLoad.from_dict(ev[1])
                 roles[rank] = loads[rank].role
+                gossip.observe(rank, loads[rank].prefix_version,
+                               loads[rank].prefix_digests)
 
     deadline = time.monotonic() + timeout_s
     while any(not rr.done for rr in reqs.values()):
@@ -595,6 +630,10 @@ def _run_router_inner(size, requests, prefill_threshold, roles,
         still: List[_RemoteRequest] = []
         for rr in pending:
             if rr.done:
+                continue
+            gate = rr.spec["after_gids"]
+            if gate and any(not reqs[g].done for g in gate):
+                still.append(rr)
                 continue
             prompt = rr.spec["prompt"]
             prefills = [
@@ -647,6 +686,7 @@ def _run_router_inner(size, requests, prefill_threshold, roles,
             "status": rr.status,
             "error": rr.error,
             "failovers": rr.failovers,
+            "replica": rr.replica,
         }
         for gid, rr in reqs.items()
     }

@@ -41,6 +41,58 @@ import numpy as np
 from chainermn_tpu.communicators import quant
 from chainermn_tpu.models.transformer import TransformerLM
 from chainermn_tpu.serving.kv_cache import PagedKVCache
+from chainermn_tpu.serving.spec import DraftModel, propose_draft as _ngram_draft
+
+#: draft proposal sources the engine can dispatch to.
+DRAFT_SOURCES = ("ngram", "model")
+ENV_DRAFT = "CHAINERMN_TPU_DRAFT"
+ENV_PREFILL_CHUNK = "CHAINERMN_TPU_PREFILL_CHUNK"
+
+
+def _resolve_draft(cfg: "EngineConfig", lm: TransformerLM) -> str:
+    """``draft`` source resolution, same order as ``kv_dtype``: explicit
+    config -> ``CHAINERMN_TPU_DRAFT`` env -> autotune cache (inert under
+    pytest / off-TPU) -> ``"ngram"``."""
+    import os
+
+    if cfg.draft is not None:
+        if cfg.draft not in DRAFT_SOURCES:
+            raise ValueError(
+                f"draft must be one of {DRAFT_SOURCES}, got {cfg.draft!r}")
+        return cfg.draft
+    env = os.environ.get(ENV_DRAFT)
+    if env is not None:
+        return env if env in DRAFT_SOURCES else "ngram"
+    try:
+        from chainermn_tpu.tuning import lookup_draft
+    except ImportError:  # pragma: no cover - partial installs
+        return "ngram"
+    return lookup_draft(
+        vocab=lm.vocab, d_model=lm.d_model, n_layers=lm.n_layers,
+        max_len=cfg.max_len, dtype=lm.dtype,
+    ) or "ngram"
+
+
+def _resolve_prefill_chunk(cfg: "EngineConfig") -> int:
+    """``prefill_chunk`` resolution (0 = off): explicit config ->
+    ``CHAINERMN_TPU_PREFILL_CHUNK`` env -> autotune cache -> off."""
+    import os
+
+    if cfg.prefill_chunk is not None:
+        return max(0, int(cfg.prefill_chunk))
+    env = os.environ.get(ENV_PREFILL_CHUNK)
+    if env is not None:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            return 0
+    try:
+        from chainermn_tpu.tuning import lookup_prefill_chunk
+    except ImportError:  # pragma: no cover - partial installs
+        return 0
+    return lookup_prefill_chunk(
+        max_len=cfg.max_len, block_size=cfg.block_size,
+    ) or 0
 
 
 def _resolve_kv_dtype(cfg: "EngineConfig", lm: TransformerLM):
@@ -116,6 +168,22 @@ class EngineConfig:
     #: T ladder for the multi-token chunk step (speculative verify and
     #: prefix-hit suffix prefill share one jitted program).
     chunk_buckets: Optional[Tuple[int, ...]] = None
+    #: speculative draft source: ``"ngram"`` (prompt lookup, free) or
+    #: ``"model"`` (layer-truncated self-draft under its own jit);
+    #: ``None`` resolves ``CHAINERMN_TPU_DRAFT`` -> tuned value ->
+    #: ``"ngram"``.  Either source is verified by the same chunk step,
+    #: so streams stay bit-exact regardless.
+    draft: Optional[str] = None
+    #: layers in the truncated draft (``draft="model"`` only); ``None``
+    #: = ``max(1, n_layers // 2)``.  ``n_layers`` gives an exact (but
+    #: pointless in production) draft — useful for acceptance tests.
+    draft_layers: Optional[int] = None
+    #: chunked prefill: prompts whose un-cached suffix exceeds this many
+    #: tokens prefill in slices of this size, interleaved with decode
+    #: iterations (bounds decode p99 under long-prompt arrival).
+    #: ``None`` resolves ``CHAINERMN_TPU_PREFILL_CHUNK`` -> tuned value
+    #: -> 0 (off); 0 pins off.
+    prefill_chunk: Optional[int] = None
 
     def resolved(self) -> "EngineConfig":
         def pow2_ladder(lo, hi):
@@ -291,6 +359,31 @@ class InferenceEngine:
         if plan is not None:
             self._apply_plan(plan, mesh)
 
+        # Draft source + chunked prefill (resolution: config -> env ->
+        # tuned -> default, like kv_dtype above).  The draft model is
+        # built AFTER plan placement so its param subset references the
+        # placed arrays, not stale host copies.
+        self.draft_source = _resolve_draft(cfg, lm)
+        self.prefill_chunk = _resolve_prefill_chunk(cfg)
+        self.draft_model: Optional[DraftModel] = None
+        if self.draft_source == "model":
+            k = cfg.draft_layers
+            if not k:
+                try:
+                    from chainermn_tpu.tuning import lookup_draft_layers
+
+                    k = lookup_draft_layers(
+                        vocab=lm.vocab, d_model=lm.d_model,
+                        n_layers=lm.n_layers, max_len=cfg.max_len,
+                        dtype=lm.dtype,
+                    )
+                except ImportError:  # pragma: no cover
+                    k = None
+            k = k or max(1, lm.n_layers // 2)
+            self.draft_model = DraftModel(
+                lm, self.params, k, cfg.prefill_buckets
+            )
+
     def _apply_plan(self, plan, mesh) -> None:
         """Tensor-parallel placement from a sharding plan: device_put
         the params and the KV pages with the plan's resolved
@@ -329,6 +422,8 @@ class InferenceEngine:
         self._cache = jax.device_put(
             self._cache, plan.shardings(mesh, self._cache)
         )
+        if getattr(self, "draft_model", None) is not None:
+            self.draft_model.rebind(self.params)
 
     # -- geometry ------------------------------------------------------
     @property
@@ -523,6 +618,19 @@ class InferenceEngine:
             if rep is not None:
                 rep.gauge("serve/kv_quant_err", self._kv_quant_err)
 
+    # -- speculative drafts --------------------------------------------
+    def propose_draft(self, context, n_draft: int) -> List[int]:
+        """Up to ``n_draft`` draft tokens continuing ``context`` from the
+        resolved draft source — n-gram prompt lookup or the truncated
+        draft model.  Either way a pure deterministic function of the
+        context alone, so the exact-match acceptance downstream keeps
+        streams bit-exact regardless of which source proposed."""
+        if n_draft <= 0:
+            return []
+        if self.draft_model is not None:
+            return self.draft_model.propose(context, n_draft)
+        return _ngram_draft(context, n_draft)
+
     # -- sampling ------------------------------------------------------
     @staticmethod
     def sample(logits: np.ndarray, params: SamplingParams,
@@ -592,6 +700,14 @@ class InferenceEngine:
         if self.kv_dtype is not None:
             out["kv_dtype"] = self.kv_dtype
             out["kv_quant_err"] = self._kv_quant_err
+        # Same shape-stability rule for the new levers: keys appear only
+        # when the feature is on.
+        if self.draft_model is not None:
+            out["draft_source"] = self.draft_source
+            out["draft_layers"] = self.draft_model.n_layers
+            out["draft_compiles"] = self.draft_model.compiles
+        if self.prefill_chunk:
+            out["prefill_chunk"] = self.prefill_chunk
         # Cross-check against jit's own cache where the API exists.
         for name, fn in (("prefill", self._prefill_jit),
                          ("decode", self._decode_jit),

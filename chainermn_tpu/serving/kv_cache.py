@@ -43,12 +43,40 @@ applies to the device pages.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from chainermn_tpu.ops.decode_attention import invalid_block
+
+
+def prefix_digest(token_ids: Sequence[int]) -> int:
+    """Content-addressed 64-bit digest of one prefix-index key (a
+    cumulative full-page token prefix).  blake2b over the little-endian
+    int64 token run, so two replicas computing the digest of the same
+    prompt prefix agree regardless of platform — the identity the
+    cluster-global prefix index gossips.  Defrag-stable for free: index
+    KEYS are token runs; :meth:`PagedKVCache.defragment` rewrites only
+    the page ids behind them."""
+    data = np.asarray(list(token_ids), dtype="<i8").tobytes()
+    return int.from_bytes(
+        hashlib.blake2b(data, digest_size=8).digest(), "big"
+    )
+
+
+def prompt_digests(token_ids: Sequence[int], block_size: int) -> List[int]:
+    """Digests of every full-page cumulative prefix of ``token_ids`` —
+    what a router computes from a *prompt alone* to probe a remote
+    replica's gossiped digest set (the remote analogue of
+    :meth:`PagedKVCache.match_prefix`)."""
+    toks = [int(t) for t in token_ids]
+    bs = int(block_size)
+    if bs <= 0:
+        return []
+    return [prefix_digest(toks[: (i + 1) * bs])
+            for i in range(len(toks) // bs)]
 
 
 class OutOfBlocks(RuntimeError):
@@ -113,6 +141,10 @@ class PagedKVCache:
         self._index: Dict[Tuple[int, ...], int] = {}
         self._index_key_of: Dict[int, Tuple[int, ...]] = {}
         self._cached: "OrderedDict[int, None]" = OrderedDict()
+        #: monotone prefix-index version — bumped on every index
+        #: mutation, the anti-entropy stamp the gossip plane publishes
+        #: alongside the digest set (cluster/prefix_gossip.py).
+        self._index_version = 0
         #: page moves performed by the most recent :meth:`defragment`.
         self._last_defrag_moves = 0
         #: (old, new) CoW splits performed by the most recent
@@ -180,7 +212,27 @@ class PagedKVCache:
             self._index[key] = page
             self._index_key_of[page] = key
             new += 1
+        if new:
+            self._index_version += 1
         return new
+
+    @property
+    def index_version(self) -> int:
+        """Monotone stamp of the prefix index's current contents — the
+        version the gossip plane publishes with :meth:`prefix_digests`
+        so receivers can apply strictly-newer snapshots only."""
+        return self._index_version
+
+    def prefix_digests(self, limit: Optional[int] = None) -> List[int]:
+        """Content digests (:func:`prefix_digest`) of every registered
+        index key, optionally capped at ``limit`` entries (wire-size
+        bound for the gossip payload).  Matching is set-membership on
+        the receiver, so order only matters under truncation — keys
+        iterate in registration order, oldest first."""
+        out = [prefix_digest(k) for k in self._index]
+        if limit is not None:
+            return out[: int(limit)]
+        return out
 
     def drop_prefix_cache(self) -> int:
         """Forget every index entry and return cached (refcount-0) pages
@@ -192,6 +244,8 @@ class PagedKVCache:
         for page in self._cached:
             self._free.append(page)
         self._cached.clear()
+        if self._index:
+            self._index_version += 1
         self._index.clear()
         self._index_key_of.clear()
         return n
@@ -211,6 +265,7 @@ class PagedKVCache:
         key = self._index_key_of.pop(page, None)
         if key is not None:
             del self._index[key]
+            self._index_version += 1
 
     def _release(self, page: int) -> None:
         """Drop one reference; at zero the page parks in the cached pool

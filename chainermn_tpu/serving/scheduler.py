@@ -30,7 +30,6 @@ from typing import Callable, Deque, Dict, List, Optional
 from chainermn_tpu.observability import tracing as _tracing
 from chainermn_tpu.serving.engine import InferenceEngine, SamplingParams
 from chainermn_tpu.serving.kv_cache import OutOfBlocks
-from chainermn_tpu.serving.spec import propose_draft
 
 
 class RequestState(Enum):
@@ -79,6 +78,11 @@ class Request:
     trace: Optional[_tracing.SpanCtx] = None
     #: tracer-clock enqueue time — the pending queue-wait span's start.
     trace_enq: Optional[float] = None
+    #: chunked prefill cursor: context position the next prefill slice
+    #: starts at, or None when the request is not mid-prefill.  While
+    #: set, the request holds its pages but is excluded from decode
+    #: batches; preemption resets it to None (full recompute).
+    prefill_pos: Optional[int] = None
 
     @property
     def context(self) -> List[int]:
@@ -132,6 +136,12 @@ class ContinuousBatchingScheduler:
         self._prefix_hit_tokens = 0
         self._spec_rows = 0
         self._spec_emitted = 0
+        # Per-draft-source acceptance accounting: the aggregate
+        # serve/spec_accept_len gauge keeps its historical name; the
+        # labelled serve/spec_accept_len/<source> twins let tools.obs
+        # compare ngram vs model acceptance side by side.
+        self._spec_rows_by: Dict[str, int] = {}
+        self._spec_emitted_by: Dict[str, int] = {}
         # In a multi-replica tier every scheduler publishes the same
         # gauge names; a replica id suffixes them ("serving/running/
         # replica/<id>") so tools.obs can split the fleet into
@@ -237,6 +247,9 @@ class ContinuousBatchingScheduler:
         self.engine.kv.free(victim.request_id)
         victim.state = RequestState.WAITING
         victim.preemptions += 1
+        # A mid-prefill victim recomputes from scratch on re-admission
+        # (its partially-written pages were just freed).
+        victim.prefill_pos = None
         self.waiting.appendleft(victim)
         if victim.trace is not None:
             tr = _tracing.get_tracer()
@@ -308,6 +321,19 @@ class ContinuousBatchingScheduler:
                     logits = self.engine.decode(
                         [req.context[-1]], [req.request_id], [hit - 1]
                     )[0]
+                elif (self.engine.prefill_chunk
+                      and len(req.context) - hit
+                      > self.engine.prefill_chunk):
+                    # Long un-cached suffix: prefill it in slices
+                    # interleaved with the decode iterations below
+                    # instead of stalling this whole step on one prompt.
+                    # Pages are already allocated (admission covers the
+                    # full context), so slices can't hit OutOfBlocks;
+                    # prefix registration and the first sampled token
+                    # wait for the final slice.  A prefix hit composes:
+                    # slices cover only the un-shared suffix.
+                    req.prefill_pos = hit
+                    continue
                 else:
                     logits = self.engine.prefill_cached(
                         req.context, req.request_id, hit
@@ -344,9 +370,47 @@ class ContinuousBatchingScheduler:
             if req._finish_if_complete():
                 self._retire(req)
 
+        # Chunked prefill: one slice per mid-prefill request per
+        # iteration, so a long prompt's prefill co-schedules with the
+        # decode batch below instead of monopolising whole steps.
+        for req in [r for r in self.running if r.prefill_pos is not None]:
+            L = len(req.context)
+            pos = req.prefill_pos
+            end = min(pos + self.engine.prefill_chunk, L)
+            rtraced = tr is not None and req.trace is not None
+            t0 = tr.clock() if rtraced else 0.0
+            logits = self.engine.chunk(
+                [req.context[pos:end]], [req.request_id], [pos]
+            )
+            if rtraced:
+                tr.record_span(
+                    "prefill_chunk", req.trace, t0, tr.clock() - t0,
+                    replica=self.replica, tokens=end - pos, pos=end,
+                    total=L,
+                )
+            if end < L:
+                req.prefill_pos = end
+                continue
+            # Final slice: the prompt is fully written — register the
+            # prefix and sample the first token at the same position a
+            # one-shot prefill would have (bit-exact by the chunk
+            # contract: logits[0, t] predicts position pos + t + 1).
+            req.prefill_pos = None
+            self.engine.kv.register_prefix(req.request_id, req.prompt)
+            tok = self.engine.sample(
+                logits[0, end - pos - 1], req.sampling, L
+            )
+            self._emit(req, tok, tr)
+            emitted += 1
+            if req._finish_if_complete():
+                self._retire(req)
+
         # One decode iteration over the whole running set.  Page growth
         # (extend) happens first so an OutOfBlocks preempts BEFORE any
-        # cache write — the evicted sequence replays cleanly.
+        # cache write — the evicted sequence replays cleanly.  Mid-
+        # prefill sequences are inert here: their allocation already
+        # covers the full context, so extend is a no-op, and they are
+        # excluded from the decode batch until their final slice lands.
         while self.running:
             try:
                 for req in self.running:
@@ -365,16 +429,20 @@ class ContinuousBatchingScheduler:
                         "sequence cannot grow within the cache even "
                         "when running alone",
                     )
-        if self.running:
-            batch = list(self.running)
+        batch = [r for r in self.running if r.prefill_pos is None]
+        if batch:
             traced_reqs = [] if tr is None else [
                 r for r in batch if r.trace is not None
             ]
-            # -- speculate: n-gram drafts from each request's own context.
-            # Best-effort page growth for the draft writes; a row whose
-            # draft can't get pages (or has no recurring n-gram) simply
-            # decodes plainly within the same batched step.
+            # -- speculate: drafts from each request's own context, via
+            # the engine's resolved source (n-gram lookup or the
+            # truncated draft model — either is a pure function of the
+            # context, so acceptance stays bit-exact).  Best-effort page
+            # growth for the draft writes; a row whose draft can't get
+            # pages (or proposes nothing) simply decodes plainly within
+            # the same batched step.
             drafts: Dict[int, List[int]] = {}
+            draft_source = getattr(self.engine, "draft_source", "ngram")
             if self.spec_tokens > 0:
                 ts0 = tr.clock() if traced_reqs else 0.0
                 for r in batch:
@@ -384,9 +452,17 @@ class ContinuousBatchingScheduler:
                         r.max_new_tokens - len(r.generated) - 1,
                         self.engine.config.max_len - len(r.context) - 1,
                     )
-                    d = propose_draft(
+                    rtraced = tr is not None and r.trace is not None
+                    td0 = tr.clock() if rtraced else 0.0
+                    d = self.engine.propose_draft(
                         r.context, min(self.spec_tokens, room)
                     )
+                    if rtraced:
+                        tr.record_span(
+                            "draft", r.trace, td0, tr.clock() - td0,
+                            replica=self.replica, source=draft_source,
+                            draft=len(d),
+                        )
                     if not d:
                         continue
                     try:
@@ -444,6 +520,13 @@ class ContinuousBatchingScheduler:
                 if drafts:
                     self._spec_rows += 1
                     self._spec_emitted += len(accept)
+                    self._spec_rows_by[draft_source] = (
+                        self._spec_rows_by.get(draft_source, 0) + 1
+                    )
+                    self._spec_emitted_by[draft_source] = (
+                        self._spec_emitted_by.get(draft_source, 0)
+                        + len(accept)
+                    )
                     accepted_by_id[req.request_id] = len(accept)
                 for tok in accept:
                     self._emit(req, tok, tr)
@@ -495,6 +578,14 @@ class ContinuousBatchingScheduler:
                     f"serve/spec_accept_len{sfx}",
                     self._spec_emitted / self._spec_rows,
                 )
+                # Labelled per-draft-source twins (satellite of the
+                # aggregate gauge above, which keeps its name).
+                for src, rows in self._spec_rows_by.items():
+                    if rows:
+                        self.reporter.gauge(
+                            f"serve/spec_accept_len/{src}{sfx}",
+                            self._spec_emitted_by[src] / rows,
+                        )
             if emitted:
                 self.reporter.count("serving/tokens", emitted)
         return emitted

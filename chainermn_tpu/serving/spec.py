@@ -1,14 +1,22 @@
-"""N-gram prompt-lookup drafting for speculative decoding.
+"""Draft proposal sources for speculative decoding.
 
-The draft model here is the *free* one (prompt-lookup decoding,
-arXiv:2304.04487 / vLLM's ngram speculator): natural-language and code
-generations repeat their own context heavily, so the most recent earlier
-occurrence of the context's trailing n-gram is a cheap, surprisingly
-accurate predictor of the next few tokens.  No parameters, no extra
-forward passes, and — crucially for this codebase's bit-exactness
-contract — a **pure deterministic function of the request's own
-context**: the proposal never depends on batch composition, scheduling
-order, or preemption history, so the accepted stream can't either.
+Two drafters share one contract — a draft is a **pure deterministic
+function of the request's own context**, so the proposal never depends
+on batch composition, scheduling order, or preemption history, and the
+accepted stream can't either:
+
+* :func:`propose_draft` — n-gram prompt-lookup (arXiv:2304.04487 /
+  vLLM's ngram speculator): the most recent earlier occurrence of the
+  context's trailing n-gram predicts the next few tokens.  Free (no
+  parameters, no forward passes) but acceptance length depends on the
+  context repeating itself.
+* :class:`DraftModel` — a layer-truncated self-draft (LayerSkip /
+  Draft&Verify style): a standalone small ``TransformerLM`` whose
+  parameters are a strict subset of the target's (embedding, the first
+  ``k`` layers, the final norm, and the tied ``embed.attend`` head), run
+  greedily under its own jit.  No training, no extra weights to ship —
+  the shallow stack is a cheap approximation of the full model that
+  proposes useful tokens even on never-repeating contexts.
 
 Acceptance is exact-match (DeepMind-style greedy speculative sampling
 specialised to our counter-based sampler): the scheduler samples token
@@ -17,12 +25,16 @@ accepts while the draft agrees, and always emits the first disagreeing
 *sampled* token as a bonus — so every step emits between 1 and
 ``len(draft) + 1`` tokens and the stream is byte-identical to the
 sequential oracle under ANY sampling params.  A bad draft costs wasted
-chunk compute, never correctness.
+chunk compute, never correctness.  The draft's own greediness is
+irrelevant to that contract: under temperature/top-k sampling a greedy
+draft just gets accepted less often.
 """
 
 from __future__ import annotations
 
 from typing import List, Sequence
+
+import numpy as np
 
 
 def propose_draft(context: Sequence[int], n_draft: int, *,
@@ -65,3 +77,110 @@ def longest_accepted(drafts: Sequence[int],
             break
         n += 1
     return n
+
+
+def draft_param_names(n_layers: int) -> List[str]:
+    """Top-level param-collection keys a ``k``-layer truncated draft
+    shares with its target ``TransformerLM``: the embedding (also the
+    tied output head via ``embed.attend``), the first ``k`` decoder
+    layers, and the final norm."""
+    return (["embed"]
+            + [f"layer_{i}" for i in range(int(n_layers))]
+            + ["final_norm"])
+
+
+class DraftModel:
+    """Layer-truncated self-draft: the target model's first ``n_layers``
+    layers run as a standalone small ``TransformerLM`` under a separate
+    jit, proposing greedy continuations of a request's context.
+
+    The draft's parameters are a **strict subset** of the target's — no
+    training, no second checkpoint, and whatever sharding plan placed
+    the target params placed these same arrays (the subset holds
+    references, not copies; :meth:`rebind` re-subsets after a
+    ``device_put``).  The rollout is ``n_draft`` sequential full-context
+    dense forwards, each padded up the engine's prefill bucket ladder so
+    the jit cache stays warm; a draft forward touches no paged cache and
+    no collectives, so it can never perturb verify state.
+
+    Determinism: greedy argmax over fp32 logits of a fixed function of
+    ``context`` — the bit-exactness contract holds regardless of the
+    request's own sampling params (see module docstring).
+    """
+
+    def __init__(self, lm, params, n_layers: int, buckets):
+        import jax
+        import jax.numpy as jnp
+
+        from chainermn_tpu.models.transformer import TransformerLM
+
+        if not 1 <= int(n_layers) <= int(lm.n_layers):
+            raise ValueError(
+                f"draft_layers ({n_layers}) must be in [1, {lm.n_layers}]")
+        self.n_layers = int(n_layers)
+        self.max_len = int(lm.max_len)
+        self.buckets = sorted(int(b) for b in buckets)
+        self.model = TransformerLM(
+            vocab=lm.vocab, d_model=lm.d_model, n_heads=lm.n_heads,
+            d_ff=lm.d_ff, n_layers=self.n_layers, max_len=lm.max_len,
+            dtype=lm.dtype, n_kv_heads=lm.n_kv_heads,
+        )
+        self.params = self._subset(params)
+        self._shapes = set()
+
+        def draft_step(params, tokens, length):
+            # (1, S) padded tokens; causal masking makes the pad inert
+            # for every query at position < length.
+            logits = self.model.apply({"params": params}, tokens)
+            row = logits[0, jnp.maximum(length - 1, 0)]
+            return jnp.argmax(row.astype(jnp.float32)).astype(jnp.int32)
+
+        self._step = jax.jit(draft_step)
+
+    def _subset(self, params):
+        missing = [k for k in draft_param_names(self.n_layers)
+                   if k not in params]
+        if missing:
+            raise ValueError(f"target params missing {missing} — not a "
+                             "TransformerLM parameter tree?")
+        return {k: params[k] for k in draft_param_names(self.n_layers)}
+
+    def rebind(self, params) -> None:
+        """Re-subset after the caller re-placed the target params (e.g.
+        ``device_put`` under a sharding plan) so the draft shares the
+        placed arrays instead of stale host copies."""
+        self.params = self._subset(params)
+
+    def _bucket(self, length: int) -> int:
+        for b in self.buckets:
+            if b >= length:
+                return b
+        return length
+
+    @property
+    def compiles(self) -> int:
+        """Distinct (bucketed) shapes the draft step has compiled."""
+        return len(self._shapes)
+
+    def propose(self, context: Sequence[int], n_draft: int) -> List[int]:
+        """Up to ``n_draft`` greedy draft tokens continuing ``context``
+        (clipped so the rollout never runs past ``max_len``)."""
+        import jax.numpy as jnp
+
+        if n_draft <= 0:
+            return []
+        ctx = [int(t) for t in context]
+        out: List[int] = []
+        for _ in range(int(n_draft)):
+            L = len(ctx)
+            if L >= self.max_len:
+                break
+            S = self._bucket(L)
+            self._shapes.add(S)
+            padded = np.zeros((1, S), np.int32)
+            padded[0, :L] = ctx
+            tok = int(self._step(self.params, jnp.asarray(padded),
+                                 jnp.asarray(L, jnp.int32)))
+            out.append(tok)
+            ctx.append(tok)
+        return out

@@ -103,15 +103,34 @@ def main(argv=None) -> int:
                     help="KV heads (default: --heads)")
     ap.add_argument("--kv-batch", type=int, default=8,
                     help="decode rows for the timing probe")
+    # Speculative draft source (docs/serving.md "Draft models").
+    ap.add_argument("--draft", action="store_true",
+                    help="also tune the speculative draft source "
+                         "(n-gram vs layer-truncated self-draft) for "
+                         "the --draft-* target family")
+    ap.add_argument("--draft-layers", type=int, default=8,
+                    help="target model depth for the draft search "
+                         "(candidate draft depths derive from it)")
+    ap.add_argument("--draft-max-len", type=int, default=512,
+                    help="serving context budget for the draft probe")
+    ap.add_argument("--draft-vocab", type=int, default=8192)
+    ap.add_argument("--draft-d-model", type=int, default=1024)
+    # Chunked-prefill slice size (docs/serving.md "Chunked prefill").
+    ap.add_argument("--prefill-chunk", action="store_true",
+                    help="also tune the chunked-prefill slice size "
+                         "(0/off vs page-aligned slices) for the "
+                         "--kv-page-size x --draft-max-len geometry")
     args = ap.parse_args(argv)
 
     from chainermn_tpu.tuning import (
         TuneCache,
         tune_allreduce_bucket,
         tune_comm_dtype,
+        tune_draft,
         tune_kv_dtype,
         tune_lm_shapes,
         tune_overlap_schedule,
+        tune_prefill_chunk,
     )
 
     log = None if args.quiet else (lambda m: print(m, file=sys.stderr))
@@ -173,6 +192,24 @@ def main(argv=None) -> int:
             n1=args.n1, repeats=args.repeats, log=log,
         )
         print(json.dumps({"kv_dtype": rec}))
+    if args.draft:
+        rec = tune_draft(
+            vocab=args.draft_vocab, d_model=args.draft_d_model,
+            n_layers=args.draft_layers, max_len=args.draft_max_len,
+            dtype=args.dtype, cache=cache, force=args.force,
+            dry_run=args.dry_run, n1=args.n1, repeats=args.repeats,
+            log=log,
+        )
+        print(json.dumps({"draft": rec}))
+    if args.prefill_chunk:
+        rec = tune_prefill_chunk(
+            max_len=args.draft_max_len, block_size=args.kv_page_size,
+            vocab=args.draft_vocab, d_model=args.draft_d_model,
+            n_layers=args.draft_layers, dtype=args.dtype, cache=cache,
+            force=args.force, dry_run=args.dry_run, n1=args.n1,
+            repeats=args.repeats, log=log,
+        )
+        print(json.dumps({"prefill_chunk": rec}))
     return 0
 
 

@@ -86,6 +86,9 @@ def _engine_factory(args):
         return InferenceEngine(model, params, EngineConfig(
             block_size=args.block_size, n_blocks=args.n_blocks,
             max_len=args.max_len, max_batch=args.max_batch,
+            draft=args.draft,
+            draft_layers=args.draft_layers,
+            prefill_chunk=args.prefill_chunk,
         ))
 
     return factory
@@ -141,6 +144,8 @@ def _report(args, results: dict, wall: float, extra: dict) -> dict:
             "max_batch": args.max_batch, "max_queue": args.max_queue,
             "watermark_blocks": args.watermark,
             "prefill_threshold": args.prefill_threshold,
+            "draft": args.draft, "draft_layers": args.draft_layers,
+            "prefill_chunk": args.prefill_chunk,
         },
     }
     report.update(extra)
@@ -561,6 +566,19 @@ def main(argv=None) -> int:
                          "prefill-role replica first (disaggregation)")
     ap.add_argument("--watermark", type=int, default=None,
                     help="free-page admission watermark per replica")
+    ap.add_argument("--draft", choices=["ngram", "model"], default=None,
+                    help="speculative draft source (with --spec-tokens):"
+                         " n-gram prompt lookup or the layer-truncated "
+                         "self-draft model (default: engine resolution "
+                         "— env, tuned cache, then ngram)")
+    ap.add_argument("--draft-layers", type=int, default=None,
+                    help="self-draft depth (--draft model; default: "
+                         "half the target's layers)")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="chunked-prefill slice size in tokens (0 = "
+                         "monolithic prefill; prompts longer than the "
+                         "slice prefill incrementally between decode "
+                         "steps — either way, --verify proves streams)")
     ap.add_argument("--spec-tokens", type=int, default=0,
                     help="speculative draft length per decode step "
                          "(0 disables; streams are bit-exact either "

@@ -51,6 +51,8 @@ from chainermn_tpu.tuning.search_space import (  # noqa: F401
     comm_dtype_search_space,
     decode_cache_key,
     decode_search_space,
+    draft_cache_key,
+    draft_search_space,
     flash_cache_key,
     flash_search_space,
     kv_dtype_cache_key,
@@ -59,23 +61,30 @@ from chainermn_tpu.tuning.search_space import (  # noqa: F401
     layout_search_space,
     overlap_cache_key,
     overlap_schedule_search_space,
+    prefill_chunk_cache_key,
+    prefill_chunk_search_space,
 )
 from chainermn_tpu.tuning.autotune import (  # noqa: F401
     lookup_bucket_bytes,
     lookup_ce_chunk,
     lookup_comm_dtype,
     lookup_decode_block_ctx,
+    lookup_draft,
+    lookup_draft_layers,
     lookup_flash_blocks,
     lookup_kv_dtype,
     lookup_layout,
     lookup_overlap_schedule,
+    lookup_prefill_chunk,
     tune_allreduce_bucket,
     tune_comm_dtype,
     tune_decode_attention,
+    tune_draft,
     tune_flash,
     tune_fused_ce,
     tune_kv_dtype,
     tune_layout,
     tune_lm_shapes,
     tune_overlap_schedule,
+    tune_prefill_chunk,
 )

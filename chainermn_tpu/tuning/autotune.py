@@ -35,6 +35,8 @@ from chainermn_tpu.tuning.search_space import (
     comm_dtype_search_space,
     decode_cache_key,
     decode_search_space,
+    draft_cache_key,
+    draft_search_space,
     flash_cache_key,
     flash_default_config,
     flash_search_space,
@@ -44,6 +46,8 @@ from chainermn_tpu.tuning.search_space import (
     layout_search_space,
     overlap_cache_key,
     overlap_schedule_search_space,
+    prefill_chunk_cache_key,
+    prefill_chunk_search_space,
 )
 
 
@@ -217,6 +221,67 @@ def lookup_kv_dtype(*, n_pages: int, page_size: int, n_kv: int,
         return canonical_kv_dtype(str(entry["kv_dtype"]))
     except Exception:
         return None
+
+
+def lookup_draft(*, vocab: int, d_model: int, n_layers: int,
+                 max_len: int, dtype) -> Optional[str]:
+    """Tuned speculative draft source (``"ngram"``/``"model"``) for one
+    target model family, or None (n-gram) on a miss / off-TPU / under
+    pytest.  Consulted by the serving engine's ``draft`` resolution
+    after the config and ``CHAINERMN_TPU_DRAFT`` overrides — inert
+    under pytest like every lookup, so tier-1 never builds a draft
+    model by surprise."""
+    if not runtime_lookup_enabled():
+        return None
+    try:
+        entry = shared_cache().get(draft_cache_key(
+            device_kind(), dtype, vocab, d_model, n_layers, max_len
+        ))
+        if not entry:
+            return None
+        src = str(entry["draft"])
+    except Exception:
+        return None
+    return src if src in ("ngram", "model") else None
+
+
+def lookup_draft_layers(*, vocab: int, d_model: int, n_layers: int,
+                        max_len: int, dtype) -> Optional[int]:
+    """Companion to :func:`lookup_draft`: the tuned draft depth for the
+    same key, or None (the engine's ``n_layers // 2`` default)."""
+    if not runtime_lookup_enabled():
+        return None
+    try:
+        entry = shared_cache().get(draft_cache_key(
+            device_kind(), dtype, vocab, d_model, n_layers, max_len
+        ))
+        if not entry or entry.get("draft") != "model":
+            return None
+        k = int(entry["draft_layers"])
+    except Exception:
+        return None
+    return k if k >= 1 else None
+
+
+def lookup_prefill_chunk(*, max_len: int,
+                         block_size: int) -> Optional[int]:
+    """Tuned chunked-prefill slice size (tokens) for one page geometry,
+    or None (0 — monolithic prefill) on a miss / off-TPU / under
+    pytest.  Consulted by the serving engine's ``prefill_chunk``
+    resolution after the config and ``CHAINERMN_TPU_PREFILL_CHUNK``
+    overrides."""
+    if not runtime_lookup_enabled():
+        return None
+    try:
+        entry = shared_cache().get(prefill_chunk_cache_key(
+            device_kind(), max_len, block_size
+        ))
+        if not entry:
+            return None
+        c = int(entry["prefill_chunk"])
+    except Exception:
+        return None
+    return c if c > 0 else None
 
 
 def lookup_layout(*, mesh, n_params: int, n_leaves: int, dtype,
@@ -969,6 +1034,237 @@ def tune_kv_dtype(
          "batch": batch},
     )
     rec["kernel"] = "kv_dtype"
+    return rec
+
+
+def _serve_model_and_engine_factory(vocab, d_model, n_heads, d_ff,
+                                    n_layers, max_len, dtype,
+                                    block_size, n_blocks, max_batch):
+    """One target LM + init params, and a factory building a fresh
+    serving engine over them per candidate config — shared scaffolding
+    for the serving-loop tuners."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chainermn_tpu.models.transformer import TransformerLM
+    from chainermn_tpu.serving.engine import EngineConfig, InferenceEngine
+
+    dt = getattr(jnp, dtype_name(dtype))
+    lm = TransformerLM(vocab=vocab, d_model=d_model, n_heads=n_heads,
+                       d_ff=d_ff, n_layers=n_layers, max_len=max_len,
+                       dtype=dt)
+    params = lm.init(jax.random.PRNGKey(0),
+                     jnp.zeros((1, 8), jnp.int32))
+
+    def make_engine(**cfg_overrides):
+        cfg = EngineConfig(block_size=block_size, n_blocks=n_blocks,
+                           max_len=max_len, max_batch=max_batch,
+                           **cfg_overrides)
+        return InferenceEngine(lm, params, cfg)
+
+    return lm, np.random.RandomState(0), make_engine
+
+
+def tune_draft(
+    *,
+    vocab: int = 8192,
+    d_model: int = 1024,
+    n_heads: int = 8,
+    d_ff: int = 4096,
+    n_layers: int = 8,
+    max_len: int = 512,
+    block_size: int = 16,
+    n_blocks: int = 256,
+    batch: int = 4,
+    prompt_len: int = 64,
+    max_new: int = 32,
+    spec_tokens: int = 4,
+    dtype="bfloat16",
+    cache: Optional[TuneCache] = None,
+    n1: int = 1,
+    repeats: int = 3,
+    force: bool = False,
+    dry_run: bool = False,
+    log: Optional[Callable[[str], None]] = None,
+) -> dict:
+    """Tune the speculative draft source for one target model family.
+
+    Times a fixed continuous-batching workload (``batch`` requests,
+    ``spec_tokens``-deep speculation) to completion under each draft
+    config — n-gram prompt lookup versus the layer-truncated self-draft
+    at each candidate depth — and persists the fastest.  Stream content
+    is identical across candidates by the exact-match acceptance
+    invariant, so wall time per workload is the whole story: the draft
+    choice trades proposal cost against accepted tokens per verify."""
+    from chainermn_tpu.serving.scheduler import (
+        ContinuousBatchingScheduler,
+        Request,
+    )
+
+    space = draft_search_space(n_layers)
+    default_cfg = dict(space[0])
+    key = draft_cache_key(
+        device_kind(), dtype, vocab, d_model, n_layers, max_len
+    )
+    if dry_run:
+        return {"kernel": "draft", "dry_run": True, "key": key,
+                "candidates": space, "default": default_cfg}
+    _require_tuning_allowed("speculative draft source")
+    cache = cache or shared_cache()
+    cached = cache.get(key) if not force else None
+    if cached and cached.get("draft"):
+        return {"kernel": "draft", "key": key, "cached": True,
+                "chosen": {"draft": str(cached["draft"]),
+                           "draft_layers": int(cached.get(
+                               "draft_layers", 0))}}
+
+    lm, rng, make_engine = _serve_model_and_engine_factory(
+        vocab, d_model, n_heads, d_ff, n_layers, max_len, dtype,
+        block_size, n_blocks, batch,
+    )
+    prompts = [
+        list(rng.randint(1, vocab, size=prompt_len).astype(int))
+        for _ in range(batch)
+    ]
+    if log:
+        log(f"draft {key}: {len(space)} candidates")
+
+    def build(cfg):
+        engine = make_engine(
+            draft=cfg["draft"],
+            draft_layers=(cfg["draft_layers"]
+                          if cfg["draft"] == "model" else None),
+        )
+
+        def run(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                sched = ContinuousBatchingScheduler(
+                    engine, spec_tokens=spec_tokens)
+                for i, p in enumerate(prompts):
+                    sched.add_request(Request(
+                        request_id=i, prompt=list(p),
+                        max_new_tokens=max_new))
+                sched.run_to_completion()
+            return time.perf_counter() - t0
+
+        return run
+
+    results = measure_candidates(build, space, n1=n1, repeats=repeats,
+                                 log=log)
+    rec = _finish(
+        key, results, default_cfg, cache,
+        {"kernel": "draft", "dtype": dtype_name(dtype), "vocab": vocab,
+         "d_model": d_model, "n_layers": n_layers, "max_len": max_len,
+         "batch": batch, "prompt_len": prompt_len, "max_new": max_new,
+         "spec_tokens": spec_tokens},
+    )
+    rec["kernel"] = "draft"
+    return rec
+
+
+def tune_prefill_chunk(
+    *,
+    max_len: int = 512,
+    block_size: int = 16,
+    vocab: int = 8192,
+    d_model: int = 1024,
+    n_heads: int = 8,
+    d_ff: int = 4096,
+    n_layers: int = 8,
+    n_blocks: int = 256,
+    decode_batch: int = 3,
+    max_new: int = 24,
+    dtype="bfloat16",
+    cache: Optional[TuneCache] = None,
+    n1: int = 1,
+    repeats: int = 3,
+    force: bool = False,
+    dry_run: bool = False,
+    log: Optional[Callable[[str], None]] = None,
+) -> dict:
+    """Tune the chunked-prefill slice size for one page geometry.
+
+    Unlike the throughput tuners, the metric here is the workload's
+    *worst decode stall*: ``decode_batch`` short requests stream while
+    one near-budget prompt arrives mid-flight, and ``run(n)`` returns
+    the summed maximum scheduler-step wall time across ``n`` workload
+    repetitions.  Monolithic prefill (0) charges the whole long prompt
+    to one step — the decode p99 spike chunked prefill exists to bound
+    — so the argmin lands on the slice size whose per-step cost hides
+    best behind the decode cadence.  Throughput is deliberately NOT the
+    objective: chunking always costs a little of it."""
+    from chainermn_tpu.serving.scheduler import (
+        ContinuousBatchingScheduler,
+        Request,
+    )
+
+    space = prefill_chunk_search_space(max_len, block_size)
+    default_cfg = dict(space[0])
+    key = prefill_chunk_cache_key(device_kind(), max_len, block_size)
+    if dry_run:
+        return {"kernel": "prefill_chunk", "dry_run": True, "key": key,
+                "candidates": space, "default": default_cfg}
+    _require_tuning_allowed("chunked-prefill slice size")
+    cache = cache or shared_cache()
+    cached = cache.get(key) if not force else None
+    if cached and cached.get("prefill_chunk") is not None:
+        return {"kernel": "prefill_chunk", "key": key, "cached": True,
+                "chosen": {"prefill_chunk": int(
+                    cached["prefill_chunk"])}}
+
+    lm, rng, make_engine = _serve_model_and_engine_factory(
+        vocab, d_model, n_heads, d_ff, n_layers, max_len, dtype,
+        block_size, n_blocks, decode_batch + 1,
+    )
+    short_len = max(block_size, max_len // 16)
+    long_len = max_len - max_new - 1
+    shorts = [
+        list(rng.randint(1, vocab, size=short_len).astype(int))
+        for _ in range(decode_batch)
+    ]
+    long_prompt = list(rng.randint(1, vocab, size=long_len).astype(int))
+    if log:
+        log(f"prefill_chunk {key}: {len(space)} candidates "
+            f"(long prompt {long_len} tok)")
+
+    def build(cfg):
+        engine = make_engine(prefill_chunk=int(cfg["prefill_chunk"]))
+
+        def run(n):
+            total = 0.0
+            for _ in range(n):
+                sched = ContinuousBatchingScheduler(engine)
+                for i, p in enumerate(shorts):
+                    sched.add_request(Request(
+                        request_id=i, prompt=list(p),
+                        max_new_tokens=max_new))
+                # warm the decode cadence before the long arrival
+                for _ in range(2):
+                    sched.step()
+                sched.add_request(Request(
+                    request_id=len(shorts), prompt=list(long_prompt),
+                    max_new_tokens=4))
+                worst = 0.0
+                while sched.has_work:
+                    t0 = time.perf_counter()
+                    sched.step()
+                    worst = max(worst, time.perf_counter() - t0)
+                total += worst
+            return total
+
+        return run
+
+    results = measure_candidates(build, space, n1=n1, repeats=repeats,
+                                 log=log)
+    rec = _finish(
+        key, results, default_cfg, cache,
+        {"kernel": "prefill_chunk", "dtype": dtype_name(dtype),
+         "max_len": max_len, "block_size": block_size,
+         "decode_batch": decode_batch, "long_len": long_len,
+         "metric": "sum of worst per-step wall time per workload"},
+    )
+    rec["kernel"] = "prefill_chunk"
     return rec
 
 

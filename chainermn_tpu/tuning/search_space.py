@@ -369,6 +369,63 @@ def kv_dtype_cache_key(dev_kind: str, dtype, n_pages: int, page_size: int,
     )
 
 
+def draft_search_space(n_layers: int) -> List[dict]:
+    """Candidate ``{"draft", "draft_layers"}`` configs for the
+    speculative draft source: ``"ngram"`` (model-free — the static
+    default) plus the layer-truncated self-draft at a few depths.
+    Deeper drafts accept longer but cost more per proposal, so the
+    trade lands differently per model family — exactly what the
+    measured argmin is for."""
+    ks = sorted({max(1, int(n_layers) // 4), max(1, int(n_layers) // 2)})
+    return [{"draft": "ngram", "draft_layers": 0}] + [
+        {"draft": "model", "draft_layers": k} for k in ks
+    ]
+
+
+def draft_cache_key(dev_kind: str, dtype, vocab: int, d_model: int,
+                    n_layers: int, max_len: int) -> str:
+    """Cache key for the draft source: a property of the target model
+    family (vocab/width/depth) and the serving context budget, under
+    its own kernel tag."""
+    return make_key(
+        "draft",
+        dev_kind,
+        dtype,
+        (("v", bucket_pow2(vocab)), ("d", bucket_pow2(d_model)),
+         ("l", int(n_layers)), ("c", bucket_pow2(max_len))),
+        {},
+    )
+
+
+def prefill_chunk_search_space(max_len: int,
+                               block_size: int) -> List[dict]:
+    """Candidate ``{"prefill_chunk"}`` token-slice sizes for chunked
+    prefill: 0 (off — monolithic prefill, the static default) plus
+    page-aligned slices strictly below the context budget.  Smaller
+    slices bound decode p99 tighter but pay more scheduler iterations
+    per prompt; the sweet spot is a property of the page geometry."""
+    out = [{"prefill_chunk": 0}]
+    for mult in (8, 16, 32, 64):
+        c = int(block_size) * mult
+        if 0 < c < int(max_len):
+            out.append({"prefill_chunk": c})
+    return out
+
+
+def prefill_chunk_cache_key(dev_kind: str, max_len: int,
+                            block_size: int) -> str:
+    """Cache key for the prefill slice size: the page geometry and
+    context budget alone (dtype-independent — the chunk program is the
+    same jitted step either way)."""
+    return make_key(
+        "prefill_chunk",
+        dev_kind,
+        "none",
+        (("c", bucket_pow2(max_len)), ("s", int(block_size))),
+        {},
+    )
+
+
 def layout_search_space(mesh_axes, params=None, mesh=None) -> List[dict]:
     """Candidate ``{"plan"}`` configs for the parameter-layout search:
     every registry sharding plan whose axes the mesh has — and, when a

@@ -29,6 +29,18 @@ bursts, Zipf shared prefixes, mixed length buckets) under an SLO-wired
 tracer: the SIGKILL lands at peak generated load, and rank 0
 additionally asserts every ``slo/burn_rate/*`` gauge stayed below 1.0
 before printing ``SERVE_TRAFFIC_OK burn_max=<x>``.
+
+With the literal argument ``gossip`` the fleet (router + 3 replicas)
+runs model-based speculative decode with chunked prefill, and the
+workload arrives in two waves to exercise the cluster-global prefix
+index: wave 1 seeds exactly one replica with a 3-page template prompt
+(plus decoy prompts elsewhere) while rank 1 — the cold-start placement
+favorite — SIGKILLs itself mid-stream, so the template's pages end up
+on a survivor the router only knows about through gossiped digests;
+wave 2 (held back via ``after_gids`` until wave 1 is done) sends
+template-prefixed prompts the router has never placed, and they must
+route to whichever survivor actually holds the template.  Rank 0
+prints ``SERVE_GOSSIP_OK holder=<rank>`` before ``SERVE_SOAK_OK``.
 """
 
 import os
@@ -40,8 +52,9 @@ def main():
     kill_after = int(sys.argv[4])
     flight_dir = sys.argv[5] if len(sys.argv) > 5 else None
     traffic = flight_dir == "traffic"
+    gossip = flight_dir == "gossip"
     flight_path = None
-    if flight_dir and not traffic:
+    if flight_dir and not traffic and not gossip:
         flight_path = os.path.join(flight_dir, f"flight_{pid}.jsonl")
 
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
@@ -66,6 +79,11 @@ def main():
     from chainermn_tpu.serving import EngineConfig, InferenceEngine
     from chainermn_tpu.serving.cluster import service
 
+    # The gossip soak runs the full speculative stack over the wire:
+    # layer-truncated self-draft + chunked prefill, verified bit-exact
+    # against the same factory's sequential oracle.
+    extra_cfg = {"draft": "model", "prefill_chunk": 8} if gossip else {}
+
     def engine_factory():
         lm = TransformerLM(vocab=32, d_model=16, n_heads=2, d_ff=32,
                            n_layers=2, max_len=64)
@@ -73,6 +91,7 @@ def main():
                          jnp.zeros((1, 8), jnp.int32))
         return InferenceEngine(lm, params, EngineConfig(
             block_size=4, n_blocks=64, max_len=64, max_batch=2,
+            **extra_cfg,
         ))
 
     if traffic:
@@ -91,6 +110,26 @@ def main():
         arrivals = workload.generate(spec)
         prompts = [list(a.prompt) for a in arrivals]
         news = [a.max_new_tokens for a in arrivals]
+    elif gossip:
+        # Wave 1 (gids 0-5): one 3-page template prompt plus five decoy
+        # prompts.  kill_after=6 < max_new=8 guarantees rank 1 (cold-
+        # start favorite, so it owns gid 0) dies before the template
+        # request can finish there — the adopting survivor re-prefills
+        # it and registers the template pages, and only gossip can tell
+        # the router which survivor that was.  Wave 2 (gids 6-7):
+        # template-prefixed prompts, gated on wave 1 via after_gids.
+        rng = np.random.default_rng(29)
+        template = [int(t) for t in rng.integers(0, 32, size=12)]
+        prompts = [template] + [
+            [int(t) for t in rng.integers(0, 32, size=int(n))]
+            for n in rng.integers(4, 11, size=5)
+        ]
+        news = [8] * 6
+        prompts += [
+            template + [int(t) for t in rng.integers(0, 32, size=6)]
+            for _ in range(2)
+        ]
+        news += [6, 6]
     else:
         rng = np.random.default_rng(13)
         prompts = [
@@ -111,6 +150,9 @@ def main():
             {"prompt": p, "max_new_tokens": n}
             for p, n in zip(prompts, news)
         ]
+        if gossip:
+            for r in requests[6:]:
+                r["after_gids"] = list(range(6))
         reporter = slo = None
         if traffic:
             from chainermn_tpu.observability.reporter import Reporter
@@ -139,6 +181,17 @@ def main():
                 failovers += rr["failovers"]
             if kill_after > 0:
                 assert failovers > 0, "nobody failed over despite kill"
+            if gossip:
+                # The template request must have outlived rank 1's
+                # SIGKILL on a survivor, and BOTH gated wave-2 requests
+                # must have routed to that exact survivor — the router
+                # never placed the template there itself, so only the
+                # gossiped digest view can have told it.
+                holder = results[0]["replica"]
+                assert holder in (2, 3), results[0]
+                routed = [results[6]["replica"], results[7]["replica"]]
+                assert routed == [holder, holder], (holder, routed)
+                print(f"SERVE_GOSSIP_OK holder={holder}")
             if traffic:
                 gauges = reporter.summary()["gauges"]
                 burns = {
@@ -165,12 +218,17 @@ def main():
 
     # Replicas.  max_queue=3 forces the router to spread the burst over
     # both replicas (cold-start placement prefers the lowest rank until
-    # its queue fills), so the doomed rank is guaranteed live work.
-    doomed = kill_after > 0 and pid == nproc - 1
+    # its queue fills), so the doomed rank is guaranteed live work.  In
+    # gossip mode the doomed rank is 1 — the cold-start favorite that
+    # owns the template request — and max_queue=2 spreads wave 1 over
+    # all three replicas.
+    doomed = kill_after > 0 and pid == (1 if gossip else nproc - 1)
     out = service.run_replica(
-        pid, nproc, engine_factory, max_queue=3,
+        pid, nproc, engine_factory,
+        max_queue=2 if gossip else 3,
         kill_after_tokens=kill_after if doomed else None,
         flight_path=flight_path,
+        spec_tokens=2 if gossip else 0,
     )
     print(f"SERVE_REPLICA_OK {pid} {out['reason']}")
     sys.stdout.flush()

@@ -385,6 +385,34 @@ def test_serving_traffic_soak_kill_at_peak_load():
     assert "SERVE_REPLICA_OK 1" in outs[1], outs[1]
 
 
+def test_serving_cluster_gossip_prefix_routing_kill9():
+    """The cluster-global prefix index soak: router + 3 replicas running
+    model-based speculative decode with chunked prefill.  Wave 1 seeds
+    one replica with a 3-page template prompt while rank 1 — the
+    cold-start placement favorite that owns the template request —
+    SIGKILLs itself mid-stream, so the template's pages are re-prefilled
+    on a survivor the router never deliberately warmed.  Wave 2's
+    template-prefixed prompts (gated on wave 1 via after_gids) must
+    route to that exact survivor purely via the gossiped digest view,
+    and every stream — both waves, through the kill — must be
+    bit-identical to the sequential single-engine oracle."""
+    import re
+
+    procs, outs = _launch(_SERVE_WORKER, 4, "6", "gossip",
+                          n_devices=1, timeout=540)
+    codes = [p.returncode for p in procs]
+    assert codes[1] == -9, f"rank 1 should die by SIGKILL: {codes}\n" \
+        + "\n".join(outs)
+    assert codes[0] == 0, f"router failed:\n{outs[0]}"
+    assert "SERVE_SOAK_OK" in outs[0], outs[0]
+    m = re.search(r"SERVE_GOSSIP_OK holder=(\d+)", outs[0])
+    assert m, outs[0]
+    assert int(m.group(1)) in (2, 3), outs[0]
+    for r in (2, 3):
+        assert codes[r] == 0, f"survivor replica {r} failed:\n{outs[r]}"
+        assert f"SERVE_REPLICA_OK {r}" in outs[r], outs[r]
+
+
 # ---------------------------------------------------------------------------
 # Elastic supervisor soaks: the WHOLE fault-tolerance loop over real
 # process boundaries — heartbeat-deadline detection, bounded teardown,

@@ -583,6 +583,242 @@ def test_chunk_recompile_counts_pinned(lm, lm_params):
 
 
 # ---------------------------------------------------------------------------
+# Model-based drafts (layer-truncated self-draft)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("sampling", [
+    SamplingParams(),
+    SamplingParams(temperature=0.8, top_k=8, seed=5),
+], ids=["greedy", "sampled"])
+def test_model_draft_streams_bit_exact(lm, lm_params, sampling):
+    """The layer-truncated self-draft proposes instead of the n-gram
+    lookup; exact-match acceptance keeps every stream byte-identical to
+    the sequential engine under greedy AND temperature/top-k sampling —
+    the draft source is a pure throughput decision."""
+    prompts = _shared_prefix_prompts()
+    seq = make_engine(lm, lm_params)
+    want = [seq.generate(p, 8, sampling=sampling) for p in prompts]
+    engine = make_engine(lm, lm_params, draft="model")
+    sched = ContinuousBatchingScheduler(engine, spec_tokens=3)
+    for i, p in enumerate(prompts):
+        sched.add_request(Request(request_id=i, prompt=list(p),
+                                  max_new_tokens=8, sampling=sampling))
+    res = sched.run_to_completion()
+    for i, w in enumerate(want):
+        assert res[i].state.value == "finished", res[i].error
+        assert res[i].generated == w, f"request {i} diverged"
+    st = engine.stats()
+    assert st["draft_source"] == "model"
+    assert st["draft_layers"] == 1          # n_layers // 2 of the 2-layer lm
+    assert sched._spec_rows_by.get("model", 0) > 0
+    engine.kv.assert_consistent()
+    assert engine.kv.used_blocks == 0
+
+
+def test_model_draft_under_pool_pressure_and_defrag(lm, lm_params,
+                                                    oracle):
+    """Acceptance churn: model drafts through a pool small enough to
+    force preemption, with defrag while prefix pages are shared —
+    every stream still bit-exact, nothing leaked."""
+    prompts = _shared_prefix_prompts()
+    engine = make_engine(lm, lm_params, n_blocks=14, max_batch=3,
+                         draft="model")
+    sched = ContinuousBatchingScheduler(engine, watermark_blocks=0,
+                                        spec_tokens=3)
+    for i, p in enumerate(prompts):
+        sched.add_request(Request(request_id=i, prompt=list(p),
+                                  max_new_tokens=6))
+    steps = 0
+    while sched.has_work:
+        sched.step()
+        steps += 1
+        if steps % 5 == 0:
+            engine.defragment()
+            engine.kv.assert_consistent()
+        assert steps < 10_000
+    res = sched.results()
+    for i, p in enumerate(prompts):
+        assert res[i].state.value == "finished", res[i].error
+        assert res[i].generated == oracle(p, 6)
+    engine.kv.assert_consistent()
+    assert engine.kv.used_blocks == 0
+
+
+def test_model_draft_exact_when_full_depth(lm, lm_params):
+    """draft_layers == the target's depth makes the draft the target:
+    under greedy every proposal is accepted, so each verify row banks
+    spec_tokens + 1 tokens — the upper bound the accept-length gauge
+    should sit at."""
+    engine = make_engine(lm, lm_params, draft="model", draft_layers=2)
+    sched = ContinuousBatchingScheduler(engine, spec_tokens=3)
+    p = prompts_for(1, rng_seed=2, lo=8, hi=9)[0]
+    sched.add_request(Request(request_id=0, prompt=p,
+                              max_new_tokens=9))
+    res = sched.run_to_completion()
+    assert res[0].state.value == "finished"
+    assert sched._spec_emitted == 4 * sched._spec_rows
+    assert engine.stats()["draft_layers"] == 2
+
+
+def test_draft_model_param_subset_and_validation(lm, lm_params):
+    """The draft params are references into the target tree — a strict
+    subset, never copies — and bad depths are loud."""
+    from chainermn_tpu.serving.spec import DraftModel, draft_param_names
+
+    engine = make_engine(lm, lm_params, draft="model")
+    dm = engine.draft_model
+    assert set(dm.params) == set(draft_param_names(1))
+    for name, sub in dm.params.items():
+        assert sub is engine.params[name]   # reference, not a copy
+    with pytest.raises(ValueError):
+        DraftModel(lm, engine.params, 3, ())   # deeper than the target
+    with pytest.raises(ValueError):
+        DraftModel(lm, engine.params, 0, ())
+
+
+# ---------------------------------------------------------------------------
+# Chunked prefill
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("sampling", [
+    SamplingParams(),
+    SamplingParams(temperature=0.7, top_k=6, seed=9),
+], ids=["greedy", "sampled"])
+def test_chunked_prefill_streams_bit_exact(lm, lm_params, sampling):
+    """Prompts longer than the chunk threshold prefill in scheduler-
+    interleaved slices; the first sampled token and every token after
+    are byte-identical to monolithic prefill."""
+    prompts = prompts_for(4, rng_seed=17, lo=14, hi=30)
+    seq = make_engine(lm, lm_params)
+    want = [seq.generate(p, 6, sampling=sampling) for p in prompts]
+    engine = make_engine(lm, lm_params, prefill_chunk=4)
+    sched = ContinuousBatchingScheduler(engine)
+    for i, p in enumerate(prompts):
+        sched.add_request(Request(request_id=i, prompt=list(p),
+                                  max_new_tokens=6, sampling=sampling))
+    res = sched.run_to_completion()
+    for i, w in enumerate(want):
+        assert res[i].state.value == "finished", res[i].error
+        assert res[i].generated == w, f"request {i} diverged"
+    assert engine.stats()["prefill_chunk"] == 4
+    engine.kv.assert_consistent()
+    assert engine.kv.used_blocks == 0
+
+
+def test_chunked_prefill_interleaves_with_decode(lm, lm_params,
+                                                 oracle):
+    """While a long prompt slices through its prefill, already-running
+    requests keep decoding — the whole point of chunking: tokens are
+    emitted for the short request during the long one's prefill
+    window."""
+    engine = make_engine(lm, lm_params, prefill_chunk=4)
+    sched = ContinuousBatchingScheduler(engine)
+    short = prompts_for(1, rng_seed=4, lo=4, hi=5)[0]
+    long_p = prompts_for(1, rng_seed=8, lo=28, hi=29)[0]
+    shortreq = Request(request_id=0, prompt=short, max_new_tokens=10)
+    sched.add_request(shortreq)
+    sched.step()                         # short admitted + first token
+    sched.add_request(Request(request_id=1, prompt=long_p,
+                              max_new_tokens=4))
+    sched.step()                         # long admitted -> mid-prefill
+    longreq = next(r for r in sched.running if r.request_id == 1)
+    assert longreq.prefill_pos is not None
+    emitted_during = 0
+    while longreq.prefill_pos is not None:
+        before = len(shortreq.generated)
+        sched.step()
+        emitted_during += len(shortreq.generated) - before
+    assert emitted_during > 0, "decode starved during chunked prefill"
+    res = sched.run_to_completion()
+    assert res[0].generated == oracle(short, 10)
+    assert res[1].generated == oracle(long_p, 4)
+    engine.kv.assert_consistent()
+    assert engine.kv.used_blocks == 0
+
+
+def test_chunked_prefill_preempted_mid_prefill_recomputes(lm, lm_params,
+                                                          oracle):
+    """Preempting a mid-prefill victim frees its partially-written
+    pages and recomputes the whole prompt on re-admission — the stream
+    is still exact."""
+    engine = make_engine(lm, lm_params, prefill_chunk=4)
+    sched = ContinuousBatchingScheduler(engine)
+    long_p = prompts_for(1, rng_seed=23, lo=20, hi=21)[0]
+    sched.add_request(Request(request_id=0, prompt=long_p,
+                              max_new_tokens=5))
+    sched.step()
+    req = sched.running[0]
+    assert req.prefill_pos is not None and req.prefill_pos < len(long_p)
+    assert sched._preempt_one()
+    assert req.prefill_pos is None and req.preemptions == 1
+    res = sched.run_to_completion()
+    assert res[0].state.value == "finished", res[0].error
+    assert res[0].generated == oracle(long_p, 5)
+    engine.kv.assert_consistent()
+    assert engine.kv.used_blocks == 0
+
+
+def test_chunked_prefill_over_prefix_hit_covers_suffix_only(
+        lm, lm_params, oracle):
+    """A prefix-cache hit composes with chunking: the slices cover only
+    the un-shared suffix, starting exactly at the hit boundary."""
+    engine = make_engine(lm, lm_params, prefill_chunk=4)
+    shared = prompts_for(1, rng_seed=5, lo=12, hi=13)[0]   # 3 full pages
+    sched = ContinuousBatchingScheduler(engine)
+    sched.add_request(Request(request_id=0, prompt=list(shared),
+                              max_new_tokens=4))
+    sched.run_to_completion()            # warm the prefix index
+    tail = prompts_for(1, rng_seed=6, lo=10, hi=11)[0]
+    p2 = shared + tail
+    starts = []
+    real_chunk = engine.chunk
+
+    def spy(rows, ids, st):
+        starts.append(int(st[0]))
+        return real_chunk(rows, ids, st)
+
+    engine.chunk = spy
+    try:
+        sched2 = ContinuousBatchingScheduler(engine)
+        sched2.add_request(Request(request_id=1, prompt=p2,
+                                   max_new_tokens=5))
+        res = sched2.run_to_completion()
+    finally:
+        engine.chunk = real_chunk
+    assert res[1].state.value == "finished", res[1].error
+    assert res[1].generated == oracle(p2, 5)
+    assert starts and min(starts) == len(shared), (
+        "slices must start at the hit boundary, not re-prefill the "
+        f"shared pages (starts={starts})"
+    )
+    assert sched2._prefix_hit_tokens >= len(shared)
+    engine.kv.assert_consistent()
+    assert engine.kv.used_blocks == 0
+
+
+def test_chunked_prefill_with_model_draft_and_sampling(lm, lm_params):
+    """The whole v2 stack at once — chunked prefill + self-draft
+    speculation + temperature sampling — still bit-exact."""
+    sp = SamplingParams(temperature=0.8, top_k=8, seed=3)
+    prompts = prompts_for(3, rng_seed=19, lo=14, hi=26)
+    seq = make_engine(lm, lm_params)
+    want = [seq.generate(p, 7, sampling=sp) for p in prompts]
+    engine = make_engine(lm, lm_params, prefill_chunk=4, draft="model")
+    sched = ContinuousBatchingScheduler(engine, spec_tokens=3)
+    for i, p in enumerate(prompts):
+        sched.add_request(Request(request_id=i, prompt=list(p),
+                                  max_new_tokens=7, sampling=sp))
+    res = sched.run_to_completion()
+    for i, w in enumerate(want):
+        assert res[i].state.value == "finished", res[i].error
+        assert res[i].generated == w, f"request {i} diverged"
+    engine.kv.assert_consistent()
+    assert engine.kv.used_blocks == 0
+
+
+# ---------------------------------------------------------------------------
 # Frontend: backpressure, deadlines, streaming
 # ---------------------------------------------------------------------------
 def test_frontend_backpressure_queue_full(lm, lm_params):

@@ -42,6 +42,7 @@ from chainermn_tpu.serving import (
     QueueFull,
     Request,
     SamplingParams,
+    prompt_digests,
 )
 from chainermn_tpu.serving.cluster import (
     HeartbeatMonitor,
@@ -450,6 +451,150 @@ def test_disagg_requeues_when_prompt_cannot_fit(lm, lm_params):
     assert out is not None and out.error is None
     assert out.snapshot.n_pages == 2
     eng2.kv.assert_consistent()  # scratch freed either way
+
+
+# ---------------------------------------------------------------------------
+# Cluster-global prefix index (gossip)
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_digest_content_addressed_and_defrag_stable():
+    """Digests are a pure function of the token run — platform-width
+    independent, and untouched by defragmentation (defrag rewrites
+    page VALUES; the index keys are token runs)."""
+    from chainermn_tpu.serving import PagedKVCache, prefix_digest, \
+        prompt_digests
+
+    toks = list(range(12))
+    d1 = prefix_digest(toks)
+    assert d1 == prefix_digest(tuple(toks))
+    assert d1 == prefix_digest(np.asarray(toks, np.int32))
+    assert d1 != prefix_digest(toks[:-1])
+    assert prompt_digests(toks, 4) == [
+        prefix_digest(toks[:4]), prefix_digest(toks[:8]),
+        prefix_digest(toks),
+    ]
+    assert prompt_digests(toks[:3], 4) == []     # no full page
+    kv = PagedKVCache(16, 4)
+    kv.allocate("a", 12)
+    kv.register_prefix("a", toks)
+    before = kv.prefix_digests()
+    kv.free("a")
+    kv.defragment()
+    assert kv.prefix_digests() == before
+    assert kv.match_prefix(toks)                 # index still serves
+
+
+def test_prefix_gossip_versioned_anti_entropy():
+    """Snapshots apply strictly-newer only: duplicates and reordered
+    deliveries are no-ops, so load-beat gossip is idempotent."""
+    from chainermn_tpu.serving.cluster import PrefixGossip
+
+    g = PrefixGossip()
+    assert g.observe("B", 2, (10, 20, 30))
+    assert not g.observe("B", 2, (10, 20, 30))       # dup
+    assert not g.observe("B", 1, (99,))              # stale reorder
+    assert g.hit_pages([10, 20, 30], "B") == 3
+    assert g.hit_pages([10, 99, 30], "B") == 1       # leading run only
+    assert g.hit_pages([99, 20], "B") == 0
+    assert g.observe("B", 5, (10,))                  # newer wins
+    assert g.hit_pages([10, 20], "B") == 1
+    assert g.best([10]) == ("B", 1)
+    g.forget("B")
+    assert g.hit_pages([10], "B") == 0 and g.replicas() == []
+
+
+def test_kv_index_version_bumps_on_mutation(lm, lm_params):
+    """Every prefix-index mutation bumps the anti-entropy stamp, so a
+    receiver can order snapshots without clocks."""
+    engine = make_engine(lm, lm_params)
+    v0 = engine.kv.index_version
+    engine.generate(prompts_for(1, rng_seed=2, lo=8, hi=9)[0], 2)
+    kv = engine.kv
+    kv.allocate("w", 8)
+    kv.register_prefix("w", list(range(8)))
+    assert kv.index_version > v0
+    v1 = kv.index_version
+    kv.free("w")
+    kv.drop_prefix_cache()
+    assert kv.index_version > v1
+
+
+def test_router_gossip_routes_to_warm_replica(lm, lm_params):
+    """Same-template traffic converges on the replica already holding
+    the template's pages — scored from the gossiped digest view, not
+    just the in-process index probe."""
+    template = prompts_for(1, rng_seed=41, lo=12, hi=13)[0]  # 3 pages
+    reps, router = _mk_cluster(lm, lm_params, n=3)
+    h0 = router.submit(list(template), 4)
+    router.run_until_idle()
+    warm = h0.replica_id
+    router.step()                        # anti-entropy load beat
+    dig = prompt_digests(template, 4)
+    assert router.gossip.hit_pages(dig, warm) >= 3
+    tails = prompts_for(3, rng_seed=43, lo=4, hi=8)
+    handles = [router.submit(template + t, 4) for t in tails]
+    router.run_until_idle()
+    want = oracle_streams(lm, lm_params,
+                          [template + t for t in tails], 4)
+    for h, w in zip(handles, want):
+        assert h.status == "finished" and h.tokens == w
+        assert h.replica_id == warm      # prefix affinity held
+    for r in reps:
+        r.engine.kv.assert_consistent()
+
+
+def test_stale_gossip_falls_back_to_local_prefill(lm, lm_params):
+    """A phantom remote hit (gossip lags the holder dropping its
+    cache) may still steer routing — but the chosen replica's
+    admission re-probes its OWN index, so the request degrades to a
+    full local prefill with the stream bit-exact, never corrupt."""
+    template = prompts_for(1, rng_seed=41, lo=12, hi=13)[0]
+    reps, router = _mk_cluster(lm, lm_params, n=2)
+    h0 = router.submit(list(template), 4)
+    router.run_until_idle()
+    warm = h0.replica_id
+    router.step()                        # gossip now advertises warm
+    # the holder loses its cache; the router's view goes stale
+    reps[warm].engine.kv.drop_prefix_cache()
+    prompt = template + prompts_for(1, rng_seed=47, lo=4, hi=5)[0]
+    h = router.submit(list(prompt), 4)
+    router.run_until_idle()
+    want = oracle_streams(lm, lm_params, [prompt], 4)[0]
+    assert h.status == "finished" and h.tokens == want
+    assert h.replica_id == warm          # routed by the stale view
+    sched = reps[warm].scheduler
+    assert sched._prefix_hit_tokens == 0  # phantom: local re-probe missed
+    reps[warm].engine.kv.assert_consistent()
+    # the next beat re-syncs the view to the replica's CURRENT index
+    # (which now holds the just-served prompt — template included —
+    # re-registered by its full local prefill)
+    router.step()
+    kv = reps[warm].engine.kv
+    assert router.gossip.version(warm) == kv.index_version
+    assert router.gossip.hit_pages(prompt_digests(template, 4), warm) \
+        == len(kv.match_prefix(template)) == 3
+
+
+def test_replica_load_gossip_fields_roundtrip(lm, lm_params):
+    """ReplicaLoad carries the digest snapshot over the wire dict
+    format unchanged, and peers predating the fields still parse."""
+    from chainermn_tpu.serving.cluster import ReplicaLoad
+
+    rep = Replica(0, make_engine(lm, lm_params))
+    rep.frontend.submit(prompts_for(1, rng_seed=41, lo=12, hi=13)[0], 2)
+    while rep.scheduler.has_work:
+        rep.step()
+    ld = rep.load()
+    assert ld.block_size == 4 and ld.prefix_version > 0
+    assert len(ld.prefix_digests) > 0
+    assert ReplicaLoad.from_dict(ld.as_dict()) == ld
+    # wire compat: an old peer's dict without the gossip fields
+    old = {k: v for k, v in ld.as_dict().items()
+           if k not in ("block_size", "prefix_version",
+                        "prefix_digests")}
+    ld_old = ReplicaLoad.from_dict(old)
+    assert ld_old.block_size == 0 and ld_old.prefix_digests == ()
 
 
 # ---------------------------------------------------------------------------

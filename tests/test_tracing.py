@@ -281,6 +281,10 @@ def _synthetic_serve_records():
     tr.record_span("placement", root, 1000.002, 0.001,
                    replica="router", target=0, kind="prefill")
     tr.record_span("queue", root, 1000.003, 0.004, replica=0, depth=1)
+    # a chunked-prefill slice: the long prompt's first pages land
+    # between decode iterations before the monolithic remainder
+    tr.record_span("prefill_chunk", root, 1000.005, 0.002, replica=0,
+                   tokens=4, pos=4, total=9)
     tr.record_span("prefill", root, 1000.008, 0.050, replica=0,
                    tokens=9, disagg=True)
     tr.record_span("handoff", root, 1000.060, 0.010, replica=1,
@@ -289,8 +293,11 @@ def _synthetic_serve_records():
     tr.token(root)
     tr.record_span("decode", root, 1000.080, 0.005, replica=1, batch=2)
     tr.token(root)
-    # a speculative iteration: draft proposal + batched verify step
-    # (the verify span replaces that iteration's decode span)
+    # a speculative iteration: per-request draft proposal, then the
+    # batched speculate + verify step (the verify span replaces that
+    # iteration's decode span)
+    tr.record_span("draft", root, 1000.088, 0.001, replica=1,
+                   source="model", draft=2)
     tr.record_span("speculate", root, 1000.089, 0.001, replica=1,
                    draft=2)
     tr.record_span("verify", root, 1000.090, 0.005, replica=1, batch=2,
@@ -358,9 +365,19 @@ def test_speculative_stages_in_trace_stats():
     st = stage_percentiles(rows)
     assert st["speculate"]["count"] == 1
     assert st["verify"]["p99_s"] == pytest.approx(0.005)
+    # the draft proposal and chunked-prefill slices are first-class
+    # stages too, parented to the same request root
+    assert st["draft"]["count"] == 1
+    assert st["prefill_chunk"]["count"] == 1
+    chunks = [r for r in rows
+              if r.get("name") in ("draft", "prefill_chunk")]
+    assert {(r["trace"], r["parent"]) for r in chunks} == \
+        {(rows[0]["trace"], rows[0]["parent"])}
     text = to_prometheus(summarize(rows), prefix="t")
     assert 't_trace_spans_total{stage="speculate"} 1' in text
     assert 't_trace_spans_total{stage="verify"} 1' in text
+    assert 't_trace_spans_total{stage="draft"} 1' in text
+    assert 't_trace_spans_total{stage="prefill_chunk"} 1' in text
 
 
 # ---------------------------------------------------------------------------
