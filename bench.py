@@ -649,6 +649,8 @@ def bench_serve(comm, args):
         out["cluster"] = bench_serve_cluster(args, model, params)
     if args.serve_traffic:
         out["traffic"] = _serve_traffic_bench(args)
+    if args.serve_long_context:
+        out["long_context"] = _serve_long_context_bench(args)
     return out
 
 
@@ -870,6 +872,189 @@ def _serve_prefill_chunk_ab(args, model, params, best):
             round(mono["p99_ms"] / chunked["p99_ms"], 3)
             if chunked["p99_ms"] and mono["p99_ms"] else None
         ),
+    }
+
+
+def _serve_long_context_bench(args):
+    """``--serve-long-context``: the giant-prompt serving story.
+
+    Three measurements, one JSON blob:
+
+    * **p99 vs prompt length** — per-token gap p99 and time-to-first-
+      token at each ``--serve-long-lens`` point, chunked prefill on, so
+      the curve shows decode latency staying flat while prompts grow
+      through lazily-added buckets (``bucket_growths`` is reported per
+      point — no fleet-wide recompile, just one new program per rung).
+    * **streaming-registration A/B** — two interleaved requests over
+      ONE shared document.  With ``stream_prefix`` on, the second
+      request adopts the slices the first already published mid-prefill
+      and computes only the unregistered suffix; with it off it
+      recomputes the whole document.  Reported: prefill slices
+      computed, ``dup_prefill_slices``, and ``stream_hit_tokens`` for
+      both arms — the acceptance bar is ON strictly below OFF on both
+      slice counts.
+    * **oracle parity** — the interleaved shared-document streams match
+      a fresh single-request engine bit-for-bit under greedy AND
+      temperature/top-k sampling, including a run where the second
+      request is preempted mid-prefill and replays through the
+      streamed pages.
+
+    Defaults are CPU-sane (hundreds of tokens); the real 100k story is
+    the same code path with ``--serve-long-lens 32768,65536,98304``.
+    """
+    from chainermn_tpu.models.transformer import TransformerLM
+    from chainermn_tpu.serving import (
+        ContinuousBatchingScheduler,
+        EngineConfig,
+        InferenceEngine,
+        SamplingParams,
+        ServeFrontend,
+    )
+
+    lens = sorted(int(x) for x in args.serve_long_lens.split(","))
+    N = min(args.serve_new_tokens, 8)  # decode length is not the story
+    bs = args.serve_block_size
+    chunk = (args.serve_prefill_chunk if args.serve_prefill_chunk > 0
+             else max(2 * bs, 16))
+    D = lens[-1]
+    max_len = max(args.serve_max_len, D + N + 1)
+    pages_per_seq = -(-(D + N) // bs)
+    n_blocks = max(args.serve_blocks, 2 * pages_per_seq + 8)
+
+    model = TransformerLM(
+        vocab=args.lm_vocab, d_model=args.lm_d_model,
+        n_heads=args.lm_heads, d_ff=args.lm_d_ff,
+        n_layers=args.lm_layers, max_len=max_len,
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )
+    rng = np.random.RandomState(11)
+    doc = rng.randint(0, args.lm_vocab, size=D).tolist()
+
+    def make_stack(*, stream, max_batch=2):
+        ecfg = EngineConfig(
+            block_size=bs, n_blocks=n_blocks, max_len=max_len,
+            max_batch=max_batch, prefill_chunk=chunk,
+        )
+        engine = InferenceEngine(model, params, ecfg)
+        sched = ContinuousBatchingScheduler(engine,
+                                            stream_prefix=stream)
+        fe = ServeFrontend(sched, max_queue=max_batch + 2)
+        return engine, sched, fe
+
+    # -- p99 vs prompt length -----------------------------------------
+    curve = []
+    for L in lens:
+        engine, sched, fe = make_stack(stream=True)
+        prompts = [rng.randint(0, args.lm_vocab, size=L).tolist()
+                   for _ in range(2)]
+
+        def run_point():
+            stamps = {}
+            submit_t = {}
+
+            def on_token(rid, tok, _s=stamps):
+                _s.setdefault(rid, []).append(time.perf_counter())
+
+            for p in prompts:
+                h = fe.submit(p, N, sampling=SamplingParams(),
+                              on_token=on_token)
+                submit_t[h.request_id] = time.perf_counter()
+            fe.run_until_idle()
+            gaps, ttfts = [], []
+            for rid, ts in stamps.items():
+                ttfts.append(ts[0] - submit_t[rid])
+                gaps.extend(b - a for a, b in zip(ts, ts[1:]))
+            gaps.sort()
+            return gaps, ttfts
+
+        run_point()  # warm: compile this length's buckets
+        gaps, ttfts = run_point()
+        st = engine.stats()
+        p99 = (gaps[min(len(gaps) - 1, int(0.99 * len(gaps)))]
+               if gaps else None)
+        curve.append({
+            "prompt_len": L,
+            "p99_token_gap_ms": round(p99 * 1e3, 3) if p99 else None,
+            "ttft_ms": round(max(ttfts) * 1e3, 3) if ttfts else None,
+            "bucket_growths": st.get("bucket_growths", 0),
+            "chunk_compiles": st["chunk_compiles"],
+        })
+
+    # -- streaming-registration A/B over one shared document ----------
+    def shared_doc_run(stream, *, sampling=None, preempt=False):
+        engine, sched, fe = make_stack(stream=stream)
+        slices = [0]
+        real_chunk = engine.chunk
+
+        def spy(token_rows, seq_ids, start_lens, *a, **k):
+            slices[0] += sum(1 for s in start_lens if int(s) >= 0)
+            return real_chunk(token_rows, seq_ids, start_lens, *a, **k)
+
+        engine.chunk = spy
+        try:
+            sp = sampling or SamplingParams()
+            ha = fe.submit(doc, N, sampling=sp)
+            for _ in range(3):  # first request gets a few slices in
+                fe.step()
+            hb = fe.submit(doc, N, sampling=sp)
+            if preempt:
+                fe.step()
+                sched._preempt_one()
+            fe.run_until_idle()
+        finally:
+            engine.chunk = real_chunk
+        return {
+            "prefill_slices": slices[0],
+            "dup_prefill_slices": sched._dup_prefill_slices,
+            "stream_hit_tokens": sched._stream_hit_tokens,
+            "tokens": (list(ha.tokens), list(hb.tokens)),
+        }
+
+    def oracle(sampling):
+        engine, sched, fe = make_stack(stream=False, max_batch=1)
+        h = fe.submit(doc, N, sampling=sampling)
+        fe.run_until_idle()
+        return list(h.tokens)
+
+    on = shared_doc_run(True)
+    off = shared_doc_run(False)
+    ab = {
+        "doc_len": D,
+        "chunk_tokens": chunk,
+        "streaming": {k: on[k] for k in
+                      ("prefill_slices", "dup_prefill_slices",
+                       "stream_hit_tokens")},
+        "no_streaming": {k: off[k] for k in
+                         ("prefill_slices", "dup_prefill_slices",
+                          "stream_hit_tokens")},
+        "dup_slices_reduced": (on["dup_prefill_slices"]
+                               < off["dup_prefill_slices"]),
+        "slices_reduced": (on["prefill_slices"]
+                           < off["prefill_slices"]),
+    }
+
+    # -- oracle parity -------------------------------------------------
+    greedy = SamplingParams()
+    sampled = SamplingParams(temperature=0.8, top_k=8, seed=123)
+    og, os_ = oracle(greedy), oracle(sampled)
+    pre = shared_doc_run(True, preempt=True)
+    samp = shared_doc_run(True, sampling=sampled)
+    parity = {
+        "greedy": "ok" if on["tokens"] == (og, og) else "FAIL",
+        "sampled": "ok" if samp["tokens"] == (os_, os_) else "FAIL",
+        "preempted_mid_prefill": (
+            "ok" if pre["tokens"] == (og, og) else "FAIL"),
+    }
+
+    return {
+        "p99_vs_prompt_len": curve,
+        "shared_doc_ab": ab,
+        "parity": parity,
+        "config": {"block_size": bs, "n_blocks": n_blocks,
+                   "max_len": max_len, "new_tokens": N,
+                   "prompt_lens": lens},
     }
 
 
@@ -1548,6 +1733,22 @@ def main(argv=None):
                          "requests' token-gap p99 with prompts "
                          "sliced at this many tokens vs monolithic "
                          "prefill")
+    ap.add_argument("--serve-long-context", action="store_true",
+                    help="long-context serving section: p99-vs-prompt-"
+                         "length curve through lazily-grown buckets, "
+                         "streaming-prefix-registration A/B (two "
+                         "interleaved requests over one shared "
+                         "document — duplicate prefill slices with "
+                         "streaming ON vs OFF), and oracle parity "
+                         "under greedy + temperature/top-k sampling "
+                         "incl. mid-prefill preemption; alone it is "
+                         "its own bench mode, with --serve it rides "
+                         "along as a \"long_context\" section")
+    ap.add_argument("--serve-long-lens", default="64,128,256",
+                    help="comma-separated prompt lengths for the "
+                         "--serve-long-context curve (CPU-sane "
+                         "default; the 100k story is e.g. "
+                         "'32768,65536,98304' on real hardware)")
     ap.add_argument("--comm-dtype", default=None,
                     choices=["none", "int8", "fp8"],
                     help="quantized gradient wire for the train benches "
@@ -1603,6 +1804,12 @@ def main(argv=None):
         # Traffic-only mode: host-side serving orchestration; no
         # communicator, default JSON shape untouched.
         print(json.dumps({"serve_traffic": _serve_traffic_bench(args)}))
+        return
+    if args.serve_long_context and not args.serve and args.only is None:
+        # Long-context-only mode: single-replica serving measurements;
+        # no communicator, default JSON shape untouched.
+        print(json.dumps(
+            {"serve_long_context": _serve_long_context_bench(args)}))
         return
     if not args.no_overlap:
         # Seed the latency-hiding / async-collective XLA flags before the

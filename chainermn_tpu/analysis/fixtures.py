@@ -3,7 +3,9 @@ plus the clean train step none of them may flag — and three deliberately
 CLEAN entries (``expect=None``): ``serving_decode`` pinning that the
 serving engine's decode step stays collective-free, ``serving_verify``
 pinning the same for the multi-token speculative-verify / prefix-hit
-chunk step, and
+chunk step, ``sharded_prefill`` pinning that the sequence-sharded
+prefill program's only collectives are its pure-concatenation K/V
+all-gathers (never a reduction), and
 ``overlap_async_pairs`` pinning that R004 reads a compiled overlapped
 schedule's ``all-reduce-start``/``-done`` pairs as ONE collective each
 instead of misdiagnosing them as a bucketing regression.
@@ -411,6 +413,69 @@ def fixture_draft_verify() -> dict:
     )
 
 
+def fixture_sharded_prefill() -> dict:
+    """The serving engine's sequence-sharded (``sp``) prefill chunk
+    step — a long prompt's slice run with its tokens split over an
+    ``sp`` mesh axis so one slice's KV working set can exceed a single
+    device.  A CLEAN fixture (``expect=None``): the ONLY collectives
+    are the per-layer K/V all-gathers that reassemble the slice before
+    the per-sequence attention — pure concatenations, no reduction.  A
+    psum here would break the serving plane's bit-exactness contract
+    (gather order is shard-count-invariant; an online-softmax merge is
+    not), so the linter must keep reading this program as reduction-
+    free."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from chainermn_tpu.communicators.base import shard_map_compat
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    geom = dict(vocab=32, d_model=16, n_heads=2, d_ff=32, n_layers=1,
+                max_len=16, page_count=8, page_size=4)
+    sp = 2
+    B, C, W = 1, 4, 4                    # global slice sp*C = 8 tokens
+    model = TransformerLM(**geom, paged="chunk", sp_axis="sp")
+    tokens = jnp.zeros((B, sp * C), jnp.int32)
+    tables = jnp.zeros((B, W), jnp.int32)
+    starts = jnp.zeros((B,), jnp.int32)
+    # init through the UNSHARDED twin: same params/cache shapes, and
+    # flax's init-time forward has no 'sp' axis to resolve.
+    init_model = TransformerLM(**geom, paged="chunk")
+    offs = starts[:, None] + jnp.arange(sp * C)[None, :]
+    variables = init_model.init(
+        jax.random.PRNGKey(0), tokens,
+        position_offset=offs, block_tables=tables, seq_lens=starts,
+    )
+    params, cache = variables["params"], variables["cache"]
+    mesh = Mesh(np.asarray(jax.devices()[:sp]), ("sp",))
+
+    def sp_chunk_step(params, cache, tokens, tables, starts):
+        c = tokens.shape[1]
+        r = lax.axis_index("sp")
+        offs = (jnp.maximum(starts, 0)[:, None] + r * c
+                + jnp.arange(c, dtype=jnp.int32)[None])
+        logits, upd = model.apply(
+            {"params": params, "cache": cache}, tokens,
+            position_offset=offs, block_tables=tables,
+            seq_lens=starts, mutable=["cache"],
+        )
+        return logits.astype(jnp.float32), upd["cache"]
+
+    fn = jax.jit(
+        shard_map_compat(
+            sp_chunk_step, mesh,
+            in_specs=(P(), P(), P(None, "sp"), P(), P()),
+            out_specs=(P(None, "sp"), P()),
+        ),
+        donate_argnums=(1,),
+    )
+    return dict(
+        target="sharded_prefill", expect=None, fn=fn,
+        args=(params, cache, tokens, tables, starts), kwargs={},
+        comm=None,
+    )
+
+
 FIXTURES: Dict[str, Callable[[], dict]] = {
     "r001": fixture_r001,
     "r002": fixture_r002,
@@ -423,6 +488,7 @@ FIXTURES: Dict[str, Callable[[], dict]] = {
     "overlap_async_pairs": fixture_overlap_async_pairs,
     "serving_decode": fixture_serving_decode,
     "serving_verify": fixture_serving_verify,
+    "sharded_prefill": fixture_sharded_prefill,
     "draft_verify": fixture_draft_verify,
 }
 

@@ -64,6 +64,11 @@ class MultiHeadAttention(nn.Module):
                                     # fp32 scales ("k_scales"/"v_scales"
                                     # cache leaves); None = pages in the
                                     # compute dtype
+    sp_axis: Optional[str] = None   # sequence-parallel chunk prefill: the
+                                    # token axis is sharded over this mesh
+                                    # axis (shard_map); K/V all-gather to
+                                    # the full slice before the page write
+                                    # (paged="chunk" only)
 
     @nn.compact
     def __call__(self, q_in, kv_in, mask=None, *, block_tables=None,
@@ -112,6 +117,13 @@ class MultiHeadAttention(nn.Module):
                 raise ValueError(
                     f"paged must be 'prefill', 'decode' or 'chunk', got "
                     f"{self.paged!r}"
+                )
+            if self.sp_axis is not None and self.paged != "chunk":
+                raise ValueError(
+                    "sp_axis shards the multi-token chunk step only; "
+                    "decode is per-token (nothing to shard) and whole-"
+                    "prompt prefill should use the chunk path when "
+                    "sequence-sharded"
                 )
             if self.page_count <= 0 or self.page_size <= 0:
                 raise ValueError("paged modes require page_count > 0 and "
@@ -204,9 +216,36 @@ class MultiHeadAttention(nn.Module):
                 # written first, then each query attends with its own
                 # causal bound — exactly what T sequential decode steps
                 # would have seen, in one lowering.
+                attn_start = seq_lens
+                if self.sp_axis is not None:
+                    # Sequence-sharded slice (Ulysses-style): this shard
+                    # holds C consecutive tokens starting at global
+                    # position seq_lens + r*C.  Gather the FULL slice's
+                    # K/V (pure concatenation — no cross-shard
+                    # reduction, so pages are byte-identical to the
+                    # unsharded chunk's), write it whole on every shard
+                    # (identical values -> the cache stays replicated),
+                    # and attend only the local queries at their global
+                    # causal bounds.  Quantization (kv_dtype) runs
+                    # after the gather, on the full slice, inside
+                    # write_kv.
+                    from jax import lax as _splax
+
+                    from chainermn_tpu.parallel.ring_attention import (
+                        gather_sequence_kv,
+                    )
+
+                    C = q.shape[1]
+                    k, v = gather_sequence_kv(k, v, self.sp_axis)
+                    r = _splax.axis_index(self.sp_axis)
+                    # Padding rows (seq_lens < 0) must stay fully
+                    # masked on every shard, not just rank 0.
+                    attn_start = jnp.where(
+                        seq_lens >= 0, seq_lens + r * C, seq_lens
+                    )
                 write_kv(write_chunk_pages, seq_lens)
                 out = paged_attention_chunk(
-                    q, pk.value, pv.value, block_tables, seq_lens,
+                    q, pk.value, pv.value, block_tables, attn_start,
                     block_ctx=_tuned_block_ctx(
                         self.page_count, self.page_size, n_kv, d_head,
                         q.dtype,
@@ -329,6 +368,7 @@ class EncoderLayer(nn.Module):
     page_count: int = 0
     page_size: int = 0
     kv_dtype: Optional[str] = None
+    sp_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, mask=None, *, block_tables=None, seq_lens=None):
@@ -338,7 +378,7 @@ class EncoderLayer(nn.Module):
             decode=self.decode, cache_len=self.cache_len,
             n_kv_heads=self.n_kv_heads, paged=self.paged,
             page_count=self.page_count, page_size=self.page_size,
-            kv_dtype=self.kv_dtype,
+            kv_dtype=self.kv_dtype, sp_axis=self.sp_axis,
         )(h, h, mask, block_tables=block_tables, seq_lens=seq_lens)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         return x + FeedForward(self.d_model, self.d_ff, self.dtype)(h)
@@ -430,6 +470,8 @@ class TransformerLM(nn.Module):
     page_size: int = 0
     kv_dtype: Optional[str] = None  # quantized pages ("int8") — see
                                     # MultiHeadAttention.kv_dtype
+    sp_axis: Optional[str] = None   # sequence-parallel chunk prefill —
+                                    # see MultiHeadAttention.sp_axis
 
     @nn.compact
     def __call__(self, tokens, position_offset=None, return_hidden=False,
@@ -512,7 +554,7 @@ class TransformerLM(nn.Module):
                 decode=self.decode, cache_len=self.max_len if self.decode else 0,
                 n_kv_heads=self.n_kv_heads, paged=self.paged,
                 page_count=self.page_count, page_size=self.page_size,
-                kv_dtype=self.kv_dtype,
+                kv_dtype=self.kv_dtype, sp_axis=self.sp_axis,
             )(x, mask, block_tables=block_tables, seq_lens=seq_lens)
         x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
         if return_hidden:

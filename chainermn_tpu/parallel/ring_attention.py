@@ -482,6 +482,30 @@ def make_ring_attention_fn(axis_name: str, causal: bool = True,
     return fn
 
 
+def gather_sequence_kv(k, v, axis_name: str):
+    """All-gather sequence-sharded K/V blocks into the full slice —
+    the Ulysses-style building block the serving engine's
+    sequence-parallel *prefill* step uses (docs/serving.md).
+
+    ``k``/``v``: (B, S_local, Hk, D) — each shard holds consecutive
+    tokens of one chunk slice.  Returns (B, S_local * n_shards, Hk, D)
+    in ring order, i.e. the exact concatenation an unsharded chunk
+    would have computed locally.
+
+    Why a gather and not the ring above: the ring's online-softmax
+    merges partial reductions in rotation order, so its accumulation
+    order (and therefore its low-order float bits) depends on the shard
+    count and total padded length.  The serving engine's contract is
+    bit-exactness against the sequential oracle *and* content-addressed
+    prefix pages that are byte-identical across bucket sizes — a plain
+    concatenation preserves both (the downstream paged attention is
+    unchanged), at the cost of materializing the slice's K/V per chip.
+    Decode never calls this; it stays collective-free."""
+    k = lax.all_gather(k, axis_name, axis=1, tiled=True)
+    v = lax.all_gather(v, axis_name, axis=1, tiled=True)
+    return k, v
+
+
 def make_zigzag_ring_attention_fn(axis_name: str, segment_ids=None):
     """Adapter for :func:`zigzag_ring_attention` (always causal; inputs
     must be in zigzag shard layout, see :func:`zigzag_indices`).

@@ -74,6 +74,12 @@ class ReplicaLoad:
     #: content digests of the replica's registered prefix-index keys
     #: (kv_cache.prefix_digest), capped at MAX_GOSSIP_DIGESTS.
     prefix_digests: Tuple[int, ...] = ()
+    #: longest context (tokens) the replica's engine has actually run a
+    #: prefill/chunk program over (engine.max_bucket) — long prompts
+    #: prefer replicas already warm at that length, so a lazily-grown
+    #: bucket ladder never recompiles fleet-wide.  0 = cold / snapshot
+    #: from a peer predating the field (wire compat).
+    max_bucket: int = 0
 
     @property
     def free_frac(self) -> float:
@@ -182,6 +188,7 @@ class Replica:
             prefix_digests=tuple(self.engine.kv.prefix_digests(
                 limit=MAX_GOSSIP_DIGESTS
             )),
+            max_bucket=self.engine.max_bucket,
         )
 
     # -- stepping (worker-side; callers hold self.lock) ----------------

@@ -225,7 +225,17 @@ class ReplicaRouter:
             if prompt_len > 0:
                 prefix_frac = (hit_pages * rep.engine.kv.block_size
                                / prompt_len)
-            key = (self.score(load, prefix_frac), repr(rep.replica_id))
+            score = self.score(load, prefix_frac)
+            # Warm-ladder affinity: a replica that has already run a
+            # context at least this long serves the prompt without a
+            # cold trace (its lazily-grown bucket ladders cover it), so
+            # nudge long prompts there instead of forcing every replica
+            # through its own growth recompile.  A flat bonus — smaller
+            # than the prefix-hit term, so actual shared pages still
+            # dominate placement.
+            if load.max_bucket > 0 and load.max_bucket >= prompt_len:
+                score += 0.25
+            key = (score, repr(rep.replica_id))
             if best_key is None or key > best_key:
                 best, best_key = rep, key
         return best

@@ -407,6 +407,15 @@ def _run_router_inner(size, requests, prefill_threshold, roles,
         # the gossip soak's second wave arrives only after the first
         # wave's pages are registered and gossiped).
         spec.setdefault("after_gids", None)
+        # Optional gossip gate: hold this request back until the
+        # cluster-global index advertises at least this many leading
+        # pages of ITS OWN prompt on some live replica.  Unlike
+        # after_gids this opens MID-flight: streaming prefix
+        # registration publishes a long document's slices while its
+        # first request is still prefilling, so a gated follower
+        # arrives mid-prefill and must be routed by the gossiped
+        # partial-prefix view alone.
+        spec.setdefault("after_index_pages", None)
         rr = _RemoteRequest(gid, spec)
         if tr is not None:
             rr.trace = tr.begin(
@@ -459,7 +468,16 @@ def _run_router_inner(size, requests, prefill_threshold, roles,
                     prefix_frac = min(
                         1.0, hit * bs / max(1, len(prompt))
                     )
-                key = (ReplicaRouter.score(ld, prefix_frac), -r)
+                score = ReplicaRouter.score(ld, prefix_frac)
+                # Warm-ladder affinity (same +0.25 nudge as the
+                # in-process router): the gossiped max_bucket names
+                # the longest context the replica has already traced
+                # programs for, so long prompts avoid a cold-compile
+                # replica when a warm one admits them.
+                if (not rr.tokens and ld.max_bucket > 0
+                        and ld.max_bucket >= len(prompt)):
+                    score += 0.25
+                key = (score, -r)
             else:
                 key = (0.0, -r)  # cold replica: neutral score
             if best_key is None or key > best_key:
@@ -636,6 +654,23 @@ def _run_router_inner(size, requests, prefill_threshold, roles,
                 still.append(rr)
                 continue
             prompt = rr.spec["prompt"]
+            pages_gate = rr.spec["after_index_pages"]
+            if pages_gate and not rr.tokens:
+                digs: Dict[int, list] = {}
+                warm = False
+                for r in sorted(alive):
+                    ld = loads.get(r)
+                    if ld is None or ld.block_size <= 0:
+                        continue
+                    bs = ld.block_size
+                    if bs not in digs:
+                        digs[bs] = prompt_digests(prompt, bs)
+                    if gossip.hit_pages(digs[bs], r) >= pages_gate:
+                        warm = True
+                        break
+                if not warm:
+                    still.append(rr)
+                    continue
             prefills = [
                 r for r in sorted(alive) if roles.get(r) == "prefill"
             ]

@@ -47,6 +47,11 @@ from chainermn_tpu.serving.spec import DraftModel, propose_draft as _ngram_draft
 DRAFT_SOURCES = ("ngram", "model")
 ENV_DRAFT = "CHAINERMN_TPU_DRAFT"
 ENV_PREFILL_CHUNK = "CHAINERMN_TPU_PREFILL_CHUNK"
+#: largest default chunk bucket (tokens) — the T ladder for slices and
+#: verify windows is capped here and grows lazily beyond (see
+#: ``EngineConfig.max_len_growth``), so a 128k ``max_len`` does not
+#: pre-declare a 128k-token chunk program.
+DEFAULT_CHUNK_CAP = 4096
 
 
 def _resolve_draft(cfg: "EngineConfig", lm: TransformerLM) -> str:
@@ -184,6 +189,20 @@ class EngineConfig:
     #: ``None`` resolves ``CHAINERMN_TPU_PREFILL_CHUNK`` -> tuned value
     #: -> 0 (off); 0 pins off.
     prefill_chunk: Optional[int] = None
+    #: sequence-parallel prefill: shard the chunk program's token axis
+    #: over this many devices (pow2; the ``sp`` registry plan supplies
+    #: the replicated placement), so one slice's activations and K/V
+    #: transients split across chips.  Decode is untouched — it stays
+    #: single-program and collective-free.  0/1 = off.
+    sp: int = 0
+    #: lazily extend the prompt/chunk/table-width bucket ladders (next
+    #: pow2, capped at ``max_len`` worth of tokens/pages) instead of
+    #: raising when a value overflows the ladder — each extension costs
+    #: exactly one traced recompile on THIS replica only (the fleet
+    #: routes long prompts to replicas whose ladders are already warm
+    #: via the gossiped ``max_bucket``).  False pins the pre-growth
+    #: hard-error behavior.
+    max_len_growth: bool = True
 
     def resolved(self) -> "EngineConfig":
         def pow2_ladder(lo, hi):
@@ -203,8 +222,13 @@ class EngineConfig:
             or pow2_ladder(1, self.max_batch),
             table_width_buckets=self.table_width_buckets
             or pow2_ladder(1, max_pages),
+            # The default chunk ladder stops at DEFAULT_CHUNK_CAP:
+            # chunk rows are prefill slices and verify windows, both
+            # small by design, so max_len=131072 must not imply 17
+            # compiled chunk programs.  Longer rows (a prefix-cached
+            # suffix without chunked prefill) grow the ladder lazily.
             chunk_buckets=self.chunk_buckets
-            or pow2_ladder(1, self.max_len),
+            or pow2_ladder(1, min(self.max_len, DEFAULT_CHUNK_CAP)),
         )
 
 
@@ -254,6 +278,53 @@ class InferenceEngine:
         self._prefill_model = TransformerLM(**twin, paged="prefill")
         self._decode_model = TransformerLM(**twin, paged="decode")
         self._chunk_model = TransformerLM(**twin, paged="chunk")
+
+        # Mutable bucket ladders: start from the resolved config and
+        # extend lazily (next pow2, capped) when max_len_growth is on —
+        # a long prompt costs one extra trace on this replica instead
+        # of a hard error, and the growth count is pinned in stats().
+        self._prefill_buckets = list(cfg.prefill_buckets)
+        self._table_buckets = list(cfg.table_width_buckets)
+        self._chunk_buckets = list(cfg.chunk_buckets)
+        self._table_cap = max(1, -(-cfg.max_len // cfg.block_size))
+        self._bucket_growths = 0
+        self._max_prefilled = 0
+
+        # Sequence-parallel prefill (docs/serving.md): a fourth jitted
+        # program — the chunk step under shard_map over the 'sp' mesh
+        # axis — used for single-row slices whose T bucket the axis
+        # divides.  Placement (params/cache replicated) comes from the
+        # 'sp' registry plan.
+        self.sp = int(cfg.sp) if cfg.sp and int(cfg.sp) > 1 else 0
+        self._sp_mesh = None
+        self._sp_chunk_model = None
+        if self.sp:
+            if self.sp & (self.sp - 1):
+                raise ValueError(
+                    f"sp must be a power of two (it has to divide the "
+                    f"pow2 chunk buckets), got {self.sp}"
+                )
+            if plan is not None:
+                raise ValueError(
+                    "sp prefill and an explicit tensor-parallel plan "
+                    "are mutually exclusive: sp brings its own mesh "
+                    "and the 'sp' registry plan"
+                )
+            devs = jax.devices() if mesh is None else list(
+                np.asarray(mesh.devices).reshape(-1)
+            )
+            if len(devs) < self.sp:
+                raise ValueError(
+                    f"sp={self.sp} needs {self.sp} devices, have "
+                    f"{len(devs)}"
+                )
+            from jax.sharding import Mesh
+
+            self._sp_mesh = Mesh(np.asarray(devs[: self.sp]), ("sp",))
+            self._sp_chunk_model = TransformerLM(
+                **twin, paged="chunk", sp_axis="sp"
+            )
+            plan, mesh = "sp", self._sp_mesh
 
         # Cache geometry without allocating a throwaway param set; zeros
         # ARE the empty pages (every table slot starts invalid, so stale
@@ -344,9 +415,54 @@ class InferenceEngine:
         self._decode_jit = jax.jit(decode_step, donate_argnums=(1,))
         self._chunk_jit = jax.jit(chunk_step, donate_argnums=(1,))
         self._cow_jit = jax.jit(cow_step, donate_argnums=(0,))
+
+        self._sp_chunk_jit = None
+        if self.sp:
+            from jax.sharding import PartitionSpec as P
+
+            from chainermn_tpu.communicators.base import shard_map_compat
+
+            def sp_chunk_step(params, cache, tokens, block_tables,
+                              start_lens):
+                # Shard body: tokens is this shard's C = T/sp
+                # consecutive slice tokens; start_lens carries the
+                # GLOBAL slice start (replicated).  The model gathers
+                # the full slice's K/V, writes it whole (identical on
+                # every shard, so the cache output is validly declared
+                # replicated), and attends the local queries — the
+                # per-shard attention start offset (r*C) is added
+                # inside the layer; positions here are global.
+                import jax.lax as _lax
+
+                C = tokens.shape[1]
+                r = _lax.axis_index("sp")
+                offs = (jnp.maximum(start_lens, 0)[:, None] + r * C
+                        + jnp.arange(C, dtype=jnp.int32)[None])
+                logits, upd = self._sp_chunk_model.apply(
+                    {"params": params, "cache": cache}, tokens,
+                    position_offset=offs,
+                    block_tables=block_tables, seq_lens=start_lens,
+                    mutable=muts,
+                )
+                if kv_q:
+                    return (logits.astype(jnp.float32), upd["cache"],
+                            _kv_err(upd))
+                return logits.astype(jnp.float32), upd["cache"]
+
+            out_specs = (P(None, "sp"), P()) + ((P(),) if kv_q else ())
+            self._sp_chunk_jit = jax.jit(
+                shard_map_compat(
+                    sp_chunk_step, self._sp_mesh,
+                    in_specs=(P(), P(), P(None, "sp"), P(), P()),
+                    out_specs=out_specs,
+                ),
+                donate_argnums=(1,),
+            )
+
         self._prefill_shapes: set = set()
         self._decode_shapes: set = set()
         self._chunk_shapes: set = set()
+        self._sp_shapes: set = set()
         self._tokens_decoded = 0
         self._tokens_prefilled = 0
         self._tokens_chunked = 0
@@ -430,11 +546,39 @@ class InferenceEngine:
     def max_batch(self) -> int:
         return self.config.max_batch
 
+    @property
+    def max_bucket(self) -> int:
+        """Longest context (tokens) this replica has actually run a
+        prefill or chunk program over — "my ladders, jit caches and
+        pages are warm up to here".  Gossiped in ``ReplicaLoad`` so the
+        router can steer a long prompt to a replica that will serve it
+        without a cold trace (and, mid-prefill, to the replica already
+        streaming that document's pages)."""
+        return self._max_prefilled
+
+    def _bucket_grow(self, value: int, ladder: List[int], cap: int,
+                     what: str) -> int:
+        """Bucket ``value`` on a mutable ladder, extending it (next
+        pow2, capped at ``cap``) instead of raising when
+        ``max_len_growth`` is on.  Every appended bucket is about to be
+        traced by the caller, so the growth count IS the extra-compile
+        count — pinned via ``stats()['bucket_growths']``."""
+        for b in ladder:
+            if value <= b:
+                return b
+        if not self.config.max_len_growth or value > cap:
+            raise ValueError(f"{what} {value} exceeds the largest bucket "
+                             f"{ladder[-1]}")
+        while ladder[-1] < value:
+            ladder.append(min(ladder[-1] * 2, cap))
+            self._bucket_growths += 1
+        return ladder[-1]
+
     def table_width(self, n_tokens: int) -> int:
         """Bucketed block-table width for a context of ``n_tokens``."""
-        return _bucket(
+        return self._bucket_grow(
             max(1, self.kv.blocks_for(n_tokens)),
-            self.config.table_width_buckets, "table width",
+            self._table_buckets, self._table_cap, "table width",
         )
 
     # -- steps ---------------------------------------------------------
@@ -454,7 +598,8 @@ class InferenceEngine:
                 f"prompt of {L} tokens leaves no room to generate within "
                 f"max_len {self.config.max_len}"
             )
-        S = _bucket(L, self.config.prefill_buckets, "prompt length")
+        S = self._bucket_grow(L, self._prefill_buckets,
+                              self.config.max_len, "prompt length")
         W = self.table_width(L)
         padded = np.zeros((1, S), np.int32)
         padded[0, :L] = toks
@@ -468,6 +613,7 @@ class InferenceEngine:
         if self.kv_dtype is not None:
             self._note_kv_err(out[2])
         self._tokens_prefilled += L
+        self._max_prefilled = max(self._max_prefilled, L)
         return np.asarray(last[0])
 
     def decode(self, tokens, seq_ids, seq_lens) -> np.ndarray:
@@ -536,9 +682,16 @@ class InferenceEngine:
         Tmax = max(len(r) for r in token_rows)
         if Tmax == 0:
             raise ValueError("empty chunk row")
-        T = _bucket(Tmax, self.config.chunk_buckets, "chunk length")
+        T = self._bucket_grow(Tmax, self._chunk_buckets,
+                              self.config.max_len, "chunk length")
         Bp = _bucket(B, self.config.batch_buckets, "decode batch")
         W = max(self.table_width(self.kv.seq_len(sid)) for sid in seq_ids)
+        # Sequence-parallel routing: single-row slices whose T bucket
+        # the sp axis divides run under the shard_map program (bit-
+        # identical — the gather is pure concatenation); everything
+        # else (multi-row verify batches, tiny buckets) stays on the
+        # single-device chunk program.
+        use_sp = bool(self.sp and B == 1 and T % self.sp == 0)
         tok = np.zeros((Bp, T), np.int32)
         start = np.full((Bp,), -1, np.int32)
         tables = np.full((Bp, W), self.kv.invalid, np.int32)
@@ -548,8 +701,13 @@ class InferenceEngine:
             tok[i, : len(row)] = np.asarray(row, np.int32)
             start[i] = int(s)
             tables[i] = self.kv.padded_table(sid, W)
-        self._chunk_shapes.add((Bp, T, W))
-        out = self._chunk_jit(
+        if use_sp:
+            self._sp_shapes.add((Bp, T, W))
+            step = self._sp_chunk_jit
+        else:
+            self._chunk_shapes.add((Bp, T, W))
+            step = self._chunk_jit
+        out = step(
             self.params, self._cache, jnp.asarray(tok),
             jnp.asarray(tables), jnp.asarray(start),
         )
@@ -557,6 +715,12 @@ class InferenceEngine:
         if self.kv_dtype is not None:
             self._note_kv_err(out[2])
         self._tokens_chunked += sum(len(r) for r in token_rows)
+        covered = max(
+            (int(s) + len(r)
+             for r, s in zip(token_rows, start_lens) if int(s) >= 0),
+            default=0,
+        )
+        self._max_prefilled = max(self._max_prefilled, covered)
         return np.asarray(logits[:B])
 
     def prefill_cached(self, token_ids, seq_id, n_cached: int) -> np.ndarray:
@@ -708,6 +872,16 @@ class InferenceEngine:
             out["draft_compiles"] = self.draft_model.compiles
         if self.prefill_chunk:
             out["prefill_chunk"] = self.prefill_chunk
+        if self.sp:
+            out["sp"] = self.sp
+            out["sp_chunk_compiles"] = len(self._sp_shapes)
+            out["sp_chunk_shapes"] = sorted(self._sp_shapes)
+        if self._bucket_growths:
+            # Lazily-grown ladder entries (== extra traces accepted on
+            # this replica); absent until a growth actually happens so
+            # the default stats shape is unchanged.
+            out["bucket_growths"] = self._bucket_growths
+        out["max_bucket"] = self._max_prefilled
         # Cross-check against jit's own cache where the API exists.
         for name, fn in (("prefill", self._prefill_jit),
                          ("decode", self._decode_jit),

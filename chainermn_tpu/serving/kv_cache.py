@@ -375,6 +375,46 @@ class PagedKVCache:
             self._release(page)
         return len(table)
 
+    def adopt_prefix(self, seq_id, pages: Sequence[int],
+                     start_idx: int = 0) -> int:
+        """Swap the head of ``seq_id``'s table for the registered
+        ``pages`` (a :meth:`match_prefix` result), sharing them instead
+        of the sequence's own copies — the streaming-prefill analogue of
+        passing ``prefix_pages`` to :meth:`allocate`: a request that is
+        mid-chunked-prefill when another sequence registers a deeper run
+        of the same document adopts the already-written pages and skips
+        recomputing them.  Entries below ``start_idx`` and entries
+        already holding the shared page are left alone.  Each swap
+        claims the shared page (resurrecting it from the cached pool if
+        parked) and releases the sequence's own page, so the pool never
+        grows — adoption cannot raise :class:`OutOfBlocks`.  Returns how
+        many table entries were swapped."""
+        table = self._tables[seq_id]
+        pages = [int(p) for p in pages]
+        if len(pages) > len(table):
+            raise ValueError(
+                f"{len(pages)} adopted pages exceed the "
+                f"{len(table)}-page table of {seq_id!r}"
+            )
+        swapped = 0
+        for i in range(int(start_idx), len(pages)):
+            page = pages[i]
+            if table[i] == page:
+                continue
+            if page not in self._index_key_of:
+                raise ValueError(
+                    f"adopted page {page} is not registered"
+                )
+            # Claim before release: the swap is reference-neutral, so
+            # no eviction can run between the two halves.
+            if page in self._cached:
+                del self._cached[page]
+            self._ref[page] = self._ref.get(page, 0) + 1
+            self._release(table[i])
+            table[i] = page
+            swapped += 1
+        return swapped
+
     # -- copy-on-write -------------------------------------------------
     def make_writable(self, seq_id, position: int) -> Optional[Tuple[int, int]]:
         """Guarantee the page holding ``position`` is privately owned by

@@ -117,13 +117,22 @@ class ContinuousBatchingScheduler:
     def __init__(self, engine: InferenceEngine,
                  watermark_blocks: Optional[int] = None,
                  reporter=None, replica=None,
-                 spec_tokens: int = 0):
+                 spec_tokens: int = 0,
+                 stream_prefix: bool = True):
         self.engine = engine
         self.watermark = (
             engine.max_batch if watermark_blocks is None
             else int(watermark_blocks)
         )
         self.reporter = reporter
+        #: streaming prefix registration: during chunked prefill each
+        #: completed slice's full pages are published to the prefix
+        #: index immediately (partial-prefix keys are valid — digests
+        #: are cumulative-run keyed), and a mid-prefill request whose
+        #: prompt is meanwhile registered DEEPER by another sequence
+        #: adopts those pages and moves its cursor past them instead of
+        #: recomputing.  Off reverts to register-at-completion (PR 15).
+        self.stream_prefix = bool(stream_prefix)
         #: draft length for speculative decoding (0 = plain one-token
         #: decode).  Drafts come from n-gram prompt lookup on each
         #: request's OWN context (serving/spec.py), so the emitted
@@ -134,6 +143,13 @@ class ContinuousBatchingScheduler:
         # Prefix-cache / speculation accounting (Reporter gauge sources).
         self._prefix_lookup_tokens = 0
         self._prefix_hit_tokens = 0
+        #: prompt tokens skipped mid-prefill by adopting pages another
+        #: sequence streamed into the index (serve/prefill_stream_hits).
+        self._stream_hit_tokens = 0
+        #: prefill slices computed over a range the index already held
+        #: (serve/dup_prefill_slices) — the duplicate work streaming
+        #: registration exists to eliminate.
+        self._dup_prefill_slices = 0
         self._spec_rows = 0
         self._spec_emitted = 0
         # Per-draft-source acceptance accounting: the aggregate
@@ -376,7 +392,40 @@ class ContinuousBatchingScheduler:
         for req in [r for r in self.running if r.prefill_pos is not None]:
             L = len(req.context)
             pos = req.prefill_pos
+            bs = self.engine.kv.block_size
+            hit_tokens = 0
+            if self.engine.kv.prefix_cache:
+                # Re-probe the index before every slice: another
+                # sequence streaming the same document may have
+                # registered pages past this cursor since the last one.
+                hit = self.engine.kv.match_prefix(req.prompt)
+                hit_tokens = len(hit) * bs
+                # Adopt only whole pages strictly below the final
+                # sampled position: the cursor stays page-aligned and
+                # the next slice writes only private pages, so adoption
+                # is a pure reference swap (never allocates, never CoWs
+                # on the hot path).
+                adopt_n = min(len(hit), (L - 1) // bs)
+                if self.stream_prefix and adopt_n * bs > pos:
+                    self.engine.kv.adopt_prefix(
+                        req.request_id, hit[:adopt_n]
+                    )
+                    skipped = adopt_n * bs - pos
+                    self._stream_hit_tokens += skipped
+                    if self.reporter is not None:
+                        self.reporter.count(
+                            "serve/prefill_stream_hits", skipped
+                        )
+                    pos = adopt_n * bs
+                    req.prefill_pos = pos
             end = min(pos + self.engine.prefill_chunk, L)
+            if min(end, hit_tokens) > pos:
+                # Part of this slice recomputes K/V the index already
+                # holds — duplicate prefill work (streaming OFF, or the
+                # sub-page tail adoption cannot cover).
+                self._dup_prefill_slices += 1
+                if self.reporter is not None:
+                    self.reporter.count("serve/dup_prefill_slices", 1)
             rtraced = tr is not None and req.trace is not None
             t0 = tr.clock() if rtraced else 0.0
             logits = self.engine.chunk(
@@ -390,6 +439,14 @@ class ContinuousBatchingScheduler:
                 )
             if end < L:
                 req.prefill_pos = end
+                if self.stream_prefix:
+                    # Publish the completed slice's full pages NOW so a
+                    # concurrent request over the same document (local,
+                    # or remote via the next gossip beat) shares them
+                    # instead of re-prefilling.
+                    self.engine.kv.register_prefix(
+                        req.request_id, req.prompt[:end]
+                    )
                 continue
             # Final slice: the prompt is fully written — register the
             # prefix and sample the first token at the same position a

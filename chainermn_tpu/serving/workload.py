@@ -95,10 +95,23 @@ class TrafficSpec:
     #: fraction of arrivals from hint-ignoring clients (lowest class).
     abusive_frac: float = 0.0
     vocab: int = 32
+    #: long-context dimension (off by default — the arrival stream is
+    #: byte-identical to pre-long specs when these stay at their
+    #: defaults).  A ``long_frac`` share of arrivals becomes a
+    #: *document dump*: its prompt is a prefix of one of
+    #: ``doc_templates`` fixed shared documents (Zipf-popular, same
+    #: exponent as the chat templates), with the prefix length drawn
+    #: from the heavy-tail ``long_buckets`` — the workload the
+    #: streaming prefix registration + chunked/sharded prefill path is
+    #: built for: concurrent requests over the same giant document.
+    long_frac: float = 0.0
+    doc_templates: int = 4
+    long_buckets: Buckets = ()
 
-    _INT = ("seed", "requests", "templates", "prefix_len", "vocab")
+    _INT = ("seed", "requests", "templates", "prefix_len", "vocab",
+            "doc_templates")
     _FLOAT = ("rate", "burst", "p_burst", "p_calm", "zipf_s",
-              "abusive_frac")
+              "abusive_frac", "long_frac")
 
     @classmethod
     def parse(cls, text: str) -> "TrafficSpec":
@@ -124,8 +137,9 @@ class TrafficSpec:
                 kw[k] = int(v)
             elif k in cls._FLOAT:
                 kw[k] = float(v)
-            elif k in ("prompt_buckets", "output_buckets"):
-                kw[k] = _parse_buckets(v)
+            elif k in ("prompt_buckets", "output_buckets",
+                       "long_buckets"):
+                kw[k] = _parse_buckets(v) if v else ()
             elif k == "class_weights":
                 kw[k] = tuple(float(x) for x in v.split("/"))
             else:
@@ -136,7 +150,8 @@ class TrafficSpec:
         out = []
         for f in dataclasses.fields(self):
             v = getattr(self, f.name)
-            if f.name in ("prompt_buckets", "output_buckets"):
+            if f.name in ("prompt_buckets", "output_buckets",
+                          "long_buckets"):
                 out.append(f"{f.name}={_fmt_buckets(v)}")
             elif f.name == "class_weights":
                 out.append(
@@ -166,6 +181,10 @@ class Arrival:
     priority: int
     abusive: bool
     template: int
+    #: document-dump arrival: prompt is a prefix of a shared long
+    #: document (``template`` then indexes past ``spec.templates`` into
+    #: the document id space).
+    long: bool = False
 
 
 def generate(spec: TrafficSpec) -> List[Arrival]:
@@ -186,6 +205,28 @@ def generate(spec: TrafficSpec) -> List[Arrival]:
     ow /= ow.sum()
     cw = np.array(spec.class_weights, float)
     cw /= cw.sum()
+    # Long-context dimension: shared documents + heavy-tail lengths.
+    # Everything here is drawn from a CHILD generator so that enabling
+    # (or resizing) the dimension never perturbs the base arrival
+    # stream above — curves stay comparable across the toggle.
+    long_on = bool(spec.long_frac > 0 and spec.long_buckets
+                   and spec.doc_templates > 0)
+    docs: List[Tuple[int, ...]] = []
+    doc_w = None
+    lw = None
+    lrng = np.random.default_rng((spec.seed, 0x10C))
+    if long_on:
+        max_doc = max(hi for _, hi, _ in spec.long_buckets)
+        docs = [
+            tuple(int(x) for x in lrng.integers(0, spec.vocab,
+                                                size=max_doc))
+            for _ in range(spec.doc_templates)
+        ]
+        doc_w = np.array([1.0 / (k + 1) ** spec.zipf_s
+                          for k in range(spec.doc_templates)])
+        doc_w /= doc_w.sum()
+        lw = np.array([w for _, _, w in spec.long_buckets], float)
+        lw /= lw.sum()
 
     arrivals: List[Arrival] = []
     t, burst = 0.0, False
@@ -205,13 +246,23 @@ def generate(spec: TrafficSpec) -> List[Arrival]:
         else:
             tail = rng.integers(0, spec.vocab, size=plen - len(prefix))
             prompt = prefix + tuple(int(x) for x in tail)
+        long = bool(long_on and lrng.random() < spec.long_frac)
+        if long:
+            # Document dump: a prefix of a shared document (pure
+            # prefix, no unique tail — that is exactly the workload
+            # streaming prefix registration de-duplicates).
+            d = int(lrng.choice(spec.doc_templates, p=doc_w))
+            lo, hi, _ = spec.long_buckets[int(lrng.choice(len(lw), p=lw))]
+            plen = int(lrng.integers(lo, hi + 1))
+            prompt = docs[d][:plen]
+            tmpl = spec.templates + d
         lo, hi, _ = spec.output_buckets[int(rng.choice(len(ow), p=ow))]
         out_len = int(rng.integers(lo, hi + 1))
         abusive = bool(rng.random() < spec.abusive_frac)
         prio = len(cw) - 1 if abusive else int(rng.choice(len(cw), p=cw))
         arrivals.append(Arrival(
             index=i, t=t, prompt=prompt, max_new_tokens=out_len,
-            priority=prio, abusive=abusive, template=tmpl,
+            priority=prio, abusive=abusive, template=tmpl, long=long,
         ))
     return arrivals
 

@@ -138,6 +138,17 @@ register_plan(ShardingPlan(
 ))
 
 register_plan(ShardingPlan(
+    name="sp",
+    rules=(_REPLICATE,),
+    axes=("sp",),
+    description="Sequence-parallel prefill (serving engine): params and "
+                "KV pages replicated over 'sp'; only the chunk "
+                "program's token axis shards (shard_map inside the "
+                "engine's sp prefill step), so one slice's activations "
+                "split across devices while decode stays single-chip.",
+))
+
+register_plan(ShardingPlan(
     name="fsdp",
     rules=_FSDP_RULES,
     axes=("data",),

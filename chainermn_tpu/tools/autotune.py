@@ -120,6 +120,13 @@ def main(argv=None) -> int:
                     help="also tune the chunked-prefill slice size "
                          "(0/off vs page-aligned slices) for the "
                          "--kv-page-size x --draft-max-len geometry")
+    # Long-context leg (docs/serving.md "Long-context serving").
+    ap.add_argument("--prefill-chunk-long", action="store_true",
+                    help="also rerun the slice-size objective at the "
+                         "long-context bucket (2x --draft-max-len, "
+                         "crossing the seed ladder via lazy bucket "
+                         "growth); its own cache key, so base and "
+                         "long-context slices tune independently")
     args = ap.parse_args(argv)
 
     from chainermn_tpu.tuning import (
@@ -210,6 +217,16 @@ def main(argv=None) -> int:
             repeats=args.repeats, log=log,
         )
         print(json.dumps({"prefill_chunk": rec}))
+    if args.prefill_chunk_long:
+        rec = tune_prefill_chunk(
+            max_len=args.draft_max_len, block_size=args.kv_page_size,
+            vocab=args.draft_vocab, d_model=args.draft_d_model,
+            n_layers=args.draft_layers, long_context=True,
+            dtype=args.dtype, cache=cache, force=args.force,
+            dry_run=args.dry_run, n1=args.n1, repeats=args.repeats,
+            log=log,
+        )
+        print(json.dumps({"prefill_chunk_long": rec}))
     return 0
 
 

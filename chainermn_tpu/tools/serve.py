@@ -89,6 +89,8 @@ def _engine_factory(args):
             draft=args.draft,
             draft_layers=args.draft_layers,
             prefill_chunk=args.prefill_chunk,
+            sp=args.sp,
+            max_len_growth=args.max_len_growth,
         ))
 
     return factory
@@ -146,6 +148,7 @@ def _report(args, results: dict, wall: float, extra: dict) -> dict:
             "prefill_threshold": args.prefill_threshold,
             "draft": args.draft, "draft_layers": args.draft_layers,
             "prefill_chunk": args.prefill_chunk,
+            "sp": args.sp, "max_len_growth": args.max_len_growth,
         },
     }
     report.update(extra)
@@ -579,6 +582,18 @@ def main(argv=None) -> int:
                          "monolithic prefill; prompts longer than the "
                          "slice prefill incrementally between decode "
                          "steps — either way, --verify proves streams)")
+    ap.add_argument("--sp", type=int, default=0,
+                    help="sequence-shard chunked prefill over this many "
+                         "devices (power of two; 0 disables; decode "
+                         "stays collective-free and streams stay "
+                         "bit-exact — --verify proves it)")
+    ap.add_argument("--max-len-growth",
+                    action=argparse.BooleanOptionalAction, default=True,
+                    help="let each replica's context-bucket ladder grow "
+                         "lazily past its seed buckets (prompts beyond "
+                         "the largest bucket compile one new bucket "
+                         "instead of being rejected); "
+                         "--no-max-len-growth pins the seed ladder")
     ap.add_argument("--spec-tokens", type=int, default=0,
                     help="speculative draft length per decode step "
                          "(0 disables; streams are bit-exact either "

@@ -1175,6 +1175,7 @@ def tune_prefill_chunk(
     n_blocks: int = 256,
     decode_batch: int = 3,
     max_new: int = 24,
+    long_context: bool = False,
     dtype="bfloat16",
     cache: Optional[TuneCache] = None,
     n1: int = 1,
@@ -1193,15 +1194,26 @@ def tune_prefill_chunk(
     to one step — the decode p99 spike chunked prefill exists to bound
     — so the argmin lands on the slice size whose per-step cost hides
     best behind the decode cadence.  Throughput is deliberately NOT the
-    objective: chunking always costs a little of it."""
+    objective: chunking always costs a little of it.
+
+    With ``long_context`` the same objective reruns at the long-context
+    bucket: the context budget doubles, the engine's seed ladder stops
+    at the BASE budget, and the long arrival crosses it via lazy bucket
+    growth — so the argmin reflects per-step cost at the GROWN bucket,
+    where a slice that hid fine at the base budget can stall decode
+    (attention over the longer context makes every slice step dearer).
+    The growth recompiles themselves are one-time and warmed out by the
+    measurement harness; the leg has its own cache key, so base and
+    long-context slice sizes tune independently."""
     from chainermn_tpu.serving.scheduler import (
         ContinuousBatchingScheduler,
         Request,
     )
 
+    ctx = int(max_len) * 2 if long_context else int(max_len)
     space = prefill_chunk_search_space(max_len, block_size)
     default_cfg = dict(space[0])
-    key = prefill_chunk_cache_key(device_kind(), max_len, block_size)
+    key = prefill_chunk_cache_key(device_kind(), ctx, block_size)
     if dry_run:
         return {"kernel": "prefill_chunk", "dry_run": True, "key": key,
                 "candidates": space, "default": default_cfg}
@@ -1214,11 +1226,11 @@ def tune_prefill_chunk(
                     cached["prefill_chunk"])}}
 
     lm, rng, make_engine = _serve_model_and_engine_factory(
-        vocab, d_model, n_heads, d_ff, n_layers, max_len, dtype,
+        vocab, d_model, n_heads, d_ff, n_layers, ctx, dtype,
         block_size, n_blocks, decode_batch + 1,
     )
     short_len = max(block_size, max_len // 16)
-    long_len = max_len - max_new - 1
+    long_len = ctx - max_new - 1
     shorts = [
         list(rng.randint(1, vocab, size=short_len).astype(int))
         for _ in range(decode_batch)
@@ -1226,10 +1238,18 @@ def tune_prefill_chunk(
     long_prompt = list(rng.randint(1, vocab, size=long_len).astype(int))
     if log:
         log(f"prefill_chunk {key}: {len(space)} candidates "
-            f"(long prompt {long_len} tok)")
+            f"(long prompt {long_len} tok"
+            + (", crosses the seed ladder" if long_context else "")
+            + ")")
 
     def build(cfg):
-        engine = make_engine(prefill_chunk=int(cfg["prefill_chunk"]))
+        over = {"prefill_chunk": int(cfg["prefill_chunk"])}
+        if long_context:
+            # Seed ladder stops at the BASE budget; the long arrival
+            # must grow past it, so measured stalls are at the grown
+            # bucket (run(1) warms the growth compiles away).
+            over["prefill_buckets"] = (int(max_len),)
+        engine = make_engine(**over)
 
         def run(n):
             total = 0.0
@@ -1260,8 +1280,9 @@ def tune_prefill_chunk(
     rec = _finish(
         key, results, default_cfg, cache,
         {"kernel": "prefill_chunk", "dtype": dtype_name(dtype),
-         "max_len": max_len, "block_size": block_size,
+         "max_len": ctx, "block_size": block_size,
          "decode_batch": decode_batch, "long_len": long_len,
+         "long_context": bool(long_context),
          "metric": "sum of worst per-step wall time per workload"},
     )
     rec["kernel"] = "prefill_chunk"

@@ -41,6 +41,17 @@ wave 2 (held back via ``after_gids`` until wave 1 is done) sends
 template-prefixed prompts the router has never placed, and they must
 route to whichever survivor actually holds the template.  Rank 0
 prints ``SERVE_GOSSIP_OK holder=<rank>`` before ``SERVE_SOAK_OK``.
+
+With the literal argument ``longctx`` the fleet (router + 2 replicas)
+exercises STREAMING prefix registration over the wire: a long document
+chunk-prefills on the cold-start favorite, each completed slice's
+pages registering in the prefix index immediately and their digests
+riding the next load beat; a follower request sharing the document is
+gated on that gossip view (``after_index_pages``), so it arrives while
+the document is STILL MID-PREFILL and must route to the warm replica —
+which the router only knows is warm through the gossiped partial
+prefix.  Rank 0 prints ``SERVE_LONGCTX_OK holder=<rank>`` before
+``SERVE_SOAK_OK``.
 """
 
 import os
@@ -53,8 +64,9 @@ def main():
     flight_dir = sys.argv[5] if len(sys.argv) > 5 else None
     traffic = flight_dir == "traffic"
     gossip = flight_dir == "gossip"
+    longctx = flight_dir == "longctx"
     flight_path = None
-    if flight_dir and not traffic and not gossip:
+    if flight_dir and not traffic and not gossip and not longctx:
         flight_path = os.path.join(flight_dir, f"flight_{pid}.jsonl")
 
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
@@ -83,6 +95,10 @@ def main():
     # layer-truncated self-draft + chunked prefill, verified bit-exact
     # against the same factory's sequential oracle.
     extra_cfg = {"draft": "model", "prefill_chunk": 8} if gossip else {}
+    if longctx:
+        # Tiny chunks stretch the document's prefill across many steps
+        # so the gated follower genuinely lands mid-prefill.
+        extra_cfg = {"prefill_chunk": 4}
 
     def engine_factory():
         lm = TransformerLM(vocab=32, d_model=16, n_heads=2, d_ff=32,
@@ -130,6 +146,22 @@ def main():
             for _ in range(2)
         ]
         news += [6, 6]
+    elif longctx:
+        # One long document (10 pages, 10 prefill slices at chunk=4)
+        # plus ONE doc-prefixed follower.  The follower is gated on the
+        # gossiped partial-prefix view (after_index_pages=6, set on the
+        # request below): it is released while the document is still
+        # mid-prefill, and only the streamed page registrations — the
+        # digests ride each load beat — can tell the router which
+        # replica is warm.  Exactly one follower: a second would eat
+        # queue/batch penalties on the busy warm replica and tie-break
+        # away to the idle one.
+        rng = np.random.default_rng(31)
+        doc = [int(t) for t in rng.integers(0, 32, size=40)]
+        prompts = [list(doc)]
+        news = [6]
+        prompts += [doc + [int(t) for t in rng.integers(0, 32, size=4)]]
+        news += [5]
     else:
         rng = np.random.default_rng(13)
         prompts = [
@@ -153,6 +185,8 @@ def main():
         if gossip:
             for r in requests[6:]:
                 r["after_gids"] = list(range(6))
+        if longctx:
+            requests[1]["after_index_pages"] = 6
         reporter = slo = None
         if traffic:
             from chainermn_tpu.observability.reporter import Reporter
@@ -192,6 +226,15 @@ def main():
                 routed = [results[6]["replica"], results[7]["replica"]]
                 assert routed == [holder, holder], (holder, routed)
                 print(f"SERVE_GOSSIP_OK holder={holder}")
+            if longctx:
+                # The follower was released by gossiped STREAMING page
+                # registrations while the document was still prefilling
+                # — it must have landed on the replica mid-prefill, not
+                # the idle one (whose free/queue score would otherwise
+                # win for a never-seen prompt).
+                holder = results[0]["replica"]
+                assert results[1]["replica"] == holder, results
+                print(f"SERVE_LONGCTX_OK holder={holder}")
             if traffic:
                 gauges = reporter.summary()["gauges"]
                 burns = {

@@ -413,6 +413,30 @@ def test_serving_cluster_gossip_prefix_routing_kill9():
         assert f"SERVE_REPLICA_OK {r}" in outs[r], outs[r]
 
 
+def test_serving_cluster_longctx_streaming_registration_soak():
+    """Streaming prefix registration over the wire: a long document
+    chunk-prefills on one replica, each completed slice's pages
+    registering in the prefix index immediately and gossiping on the
+    next load beat.  A doc-prefixed follower is gated on that gossiped
+    partial view (after_index_pages) so it arrives MID-PREFILL, and
+    must route to the warm replica — which the router only knows about
+    through the streamed registrations — with both streams bit-exact
+    against the sequential single-engine oracle."""
+    import re
+
+    procs, outs = _launch(_SERVE_WORKER, 3, "0", "longctx",
+                          n_devices=1, timeout=420)
+    codes = [p.returncode for p in procs]
+    assert codes[0] == 0, f"router failed:\n{outs[0]}"
+    assert "SERVE_SOAK_OK" in outs[0], outs[0]
+    m = re.search(r"SERVE_LONGCTX_OK holder=(\d+)", outs[0])
+    assert m, outs[0]
+    assert int(m.group(1)) in (1, 2), outs[0]
+    for r in (1, 2):
+        assert codes[r] == 0, f"replica {r} failed:\n{outs[r]}"
+        assert f"SERVE_REPLICA_OK {r}" in outs[r], outs[r]
+
+
 # ---------------------------------------------------------------------------
 # Elastic supervisor soaks: the WHOLE fault-tolerance loop over real
 # process boundaries — heartbeat-deadline detection, bounded teardown,

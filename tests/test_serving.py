@@ -46,6 +46,10 @@ CENSUS_GOLDEN_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)),
     "golden", "serving_decode_census.json",
 )
+SP_CENSUS_GOLDEN_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "golden", "serving_sp_prefill_census.json",
+)
 
 VOCAB = 32
 
@@ -819,6 +823,259 @@ def test_chunked_prefill_with_model_draft_and_sampling(lm, lm_params):
 
 
 # ---------------------------------------------------------------------------
+# Long context: streaming prefix registration, bucket growth, sp prefill
+# ---------------------------------------------------------------------------
+def _slice_spy(engine):
+    """Wrap ``engine.chunk`` recording ``(seq_id, start, end)`` per
+    non-padding row; returns (calls, original) — restore in finally."""
+    calls = []
+    real = engine.chunk
+
+    def spy(rows, ids, starts):
+        for row, sid, st in zip(rows, ids, starts):
+            if int(st) >= 0:
+                calls.append((sid, int(st), int(st) + len(row)))
+        return real(rows, ids, starts)
+
+    engine.chunk = spy
+    return calls, real
+
+
+def test_streaming_registration_interleaved_doc_prefills_once(
+        lm, lm_params, oracle):
+    """Two interleaved requests over ONE shared document: each
+    completed slice is registered immediately, the trailing request
+    adopts it and computes the NEXT slice, so the document's body pages
+    are computed exactly once ACROSS the pair (the leapfrog).  Only the
+    sub-page tail — where both must sample their own first token — is
+    computed twice.  ``stream_prefix=False`` reverts to register-at-
+    completion: the document is prefilled twice."""
+    doc = prompts_for(1, rng_seed=41, lo=40, hi=41)[0]
+    want = oracle(doc, 5)
+    page = 4
+    body = (len(doc) - 1) // page * page   # the adoptable full pages
+
+    def interleaved(stream):
+        engine = make_engine(lm, lm_params, prefill_chunk=4)
+        sched = ContinuousBatchingScheduler(engine,
+                                            stream_prefix=stream)
+        calls, real = _slice_spy(engine)
+        try:
+            sched.add_request(Request(request_id=0, prompt=list(doc),
+                                      max_new_tokens=5))
+            sched.step()
+            sched.step()        # A mid-prefill, slices registered
+            sched.add_request(Request(request_id=1, prompt=list(doc),
+                                      max_new_tokens=5))
+            res = sched.run_to_completion()
+        finally:
+            engine.chunk = real
+        for i in (0, 1):
+            assert res[i].state.value == "finished", res[i].error
+            assert res[i].generated == want, f"request {i} diverged"
+        engine.kv.assert_consistent()
+        assert engine.kv.used_blocks == 0
+        return calls, sched
+
+    on_calls, on_sched = interleaved(True)
+    cov = [0] * len(doc)
+    for _, s, e in on_calls:
+        for i in range(s, min(e, len(doc))):
+            cov[i] += 1
+    assert all(c == 1 for c in cov[:body]), (
+        f"document body prefilled more than once: {cov}"
+    )
+    assert on_sched._stream_hit_tokens > 0
+
+    off_calls, off_sched = interleaved(False)
+    assert len(off_calls) > len(on_calls)
+    assert on_sched._dup_prefill_slices < off_sched._dup_prefill_slices
+    b_on = sum(1 for c in on_calls if c[0] == 1)
+    b_off = sum(1 for c in off_calls if c[0] == 1)
+    assert b_on < b_off
+
+
+def test_streaming_registration_survives_preemption(lm, lm_params,
+                                                    oracle):
+    """A mid-prefill victim's streamed slices stay registered (its
+    pages park at refcount 0 in the reusable pool); both its own replay
+    and a later request over the same document claim them at admission
+    instead of recomputing — and the streams stay exact."""
+    doc = prompts_for(1, rng_seed=43, lo=36, hi=37)[0]
+    engine = make_engine(lm, lm_params, prefill_chunk=4)
+    sched = ContinuousBatchingScheduler(engine)
+    sched.add_request(Request(request_id=0, prompt=list(doc),
+                              max_new_tokens=4))
+    for _ in range(3):
+        sched.step()
+    req = sched.running[0]
+    assert req.prefill_pos is not None and req.prefill_pos < len(doc)
+    assert sched._preempt_one()
+    sched.add_request(Request(request_id=1, prompt=list(doc),
+                              max_new_tokens=4))
+    res = sched.run_to_completion()
+    want = oracle(doc, 4)
+    for i in (0, 1):
+        assert res[i].state.value == "finished", res[i].error
+        assert res[i].generated == want, f"request {i} diverged"
+    # admission claimed the preempted request's streamed pages
+    assert sched._prefix_hit_tokens > 0
+    engine.kv.assert_consistent()
+    assert engine.kv.used_blocks == 0
+
+
+def test_streaming_registration_defrag_while_shared(lm, lm_params,
+                                                    oracle):
+    """Compaction moves pages while two mid-prefill requests share the
+    streamed document run — block tables and the prefix index follow
+    the permutation, streams stay exact."""
+    doc = prompts_for(1, rng_seed=45, lo=40, hi=41)[0]
+    engine = make_engine(lm, lm_params, prefill_chunk=4)
+    sched = ContinuousBatchingScheduler(engine)
+    sched.add_request(Request(request_id=0, prompt=list(doc),
+                              max_new_tokens=4))
+    sched.step()
+    sched.step()
+    sched.add_request(Request(request_id=1, prompt=list(doc),
+                              max_new_tokens=4))
+    steps = 0
+    while sched.has_work:
+        sched.step()
+        steps += 1
+        if steps % 3 == 0:
+            # punch a hole so compaction really moves live pages
+            engine.kv.allocate("lo", engine.kv.block_size)
+            engine.kv.allocate("hi", engine.kv.block_size)
+            engine.kv.free("lo")
+            engine.defragment()
+            engine.kv.free("hi")
+            engine.kv.assert_consistent()
+        assert steps < 10_000
+    res = sched.results()
+    want = oracle(doc, 4)
+    for i in (0, 1):
+        assert res[i].state.value == "finished", res[i].error
+        assert res[i].generated == want, f"request {i} diverged"
+    engine.kv.assert_consistent()
+    assert engine.kv.used_blocks == 0
+
+
+def test_bucket_ladder_grows_lazily(lm, lm_params, oracle):
+    """A prompt past the largest configured prefill bucket no longer
+    raises: the ladder grows pow2 rungs (capped at max_len) on first
+    use, one compile per new rung, and ``max_bucket`` tracks the
+    longest context actually run.  ``max_len_growth=False`` restores
+    the hard error."""
+    engine = make_engine(lm, lm_params, prefill_buckets=(8,))
+    prompt = prompts_for(1, rng_seed=47, lo=20, hi=21)[0]
+    assert engine.generate(prompt, 4) == oracle(prompt, 4)
+    st = engine.stats()
+    assert st["bucket_growths"] >= 2       # 8 -> 16 -> 32
+    assert st["max_bucket"] >= len(prompt)
+    # grown rungs are cached like configured ones: the same length
+    # profile again compiles nothing new
+    assert engine.generate(prompt, 4) == oracle(prompt, 4)
+    st2 = engine.stats()
+    assert st2["prefill_compiles"] == st["prefill_compiles"]
+    assert st2["bucket_growths"] == st["bucket_growths"]
+    frozen = make_engine(lm, lm_params, prefill_buckets=(8,),
+                         max_len_growth=False)
+    with pytest.raises(ValueError, match="exceeds the largest bucket"):
+        frozen.generate(prompt, 4)
+
+
+def test_scheduler_admits_prompts_past_bucket_ladder(lm, lm_params,
+                                                     oracle):
+    """Satellite of the ladder growth: admission is bounded by max_len
+    alone — a prompt longer than every configured bucket flows through
+    chunked prefill (its chunk ladder growing as needed) instead of
+    failing the request."""
+    engine = make_engine(lm, lm_params, prefill_buckets=(8,),
+                         chunk_buckets=(2,), prefill_chunk=4)
+    sched = ContinuousBatchingScheduler(engine)
+    prompt = prompts_for(1, rng_seed=53, lo=40, hi=41)[0]
+    sched.add_request(Request(request_id=0, prompt=list(prompt),
+                              max_new_tokens=4))
+    res = sched.run_to_completion()
+    assert res[0].state.value == "finished", res[0].error
+    assert res[0].generated == oracle(prompt, 4)
+    st = engine.stats()
+    assert st["bucket_growths"] >= 1       # chunk ladder 2 -> 4
+    assert engine.max_bucket >= len(prompt)
+    engine.kv.assert_consistent()
+    assert engine.kv.used_blocks == 0
+
+
+def test_sp_sharded_prefill_streams_bit_exact(lm, lm_params):
+    """sp>1 runs each prefill slice over a sequence-sharded mesh axis;
+    the K/V reassembly is a pure concatenation (all_gather, no
+    reduction), so streams are byte-identical to the unsharded engine
+    under greedy AND sampled decoding — and decode still runs the
+    plain collective-free program."""
+    prompts = prompts_for(3, rng_seed=59, lo=17, hi=33)
+    sampled = SamplingParams(temperature=0.7, top_k=6, seed=5)
+
+    def run(engine):
+        sched = ContinuousBatchingScheduler(engine)
+        for i, p in enumerate(prompts):
+            sched.add_request(Request(
+                request_id=i, prompt=list(p), max_new_tokens=5,
+                sampling=SamplingParams() if i % 2 else sampled,
+            ))
+        res = sched.run_to_completion()
+        for i in range(len(prompts)):
+            assert res[i].state.value == "finished", res[i].error
+        return [res[i].generated for i in range(len(prompts))]
+
+    want = run(make_engine(lm, lm_params, prefill_chunk=8))
+    for sp in (2, 4):
+        engine = make_engine(lm, lm_params, prefill_chunk=8, sp=sp)
+        assert run(engine) == want, f"sp={sp} diverged"
+        st = engine.stats()
+        assert st["sp"] == sp and st["sp_chunk_compiles"] >= 1
+        assert st["decode_compiles"] >= 1
+        engine.kv.assert_consistent()
+        assert engine.kv.used_blocks == 0
+    with pytest.raises(ValueError, match="power of two"):
+        make_engine(lm, lm_params, sp=3)
+    with pytest.raises(ValueError, match="devices"):
+        make_engine(lm, lm_params, sp=16)
+
+
+def test_stream_counters_flow_to_prometheus(lm, lm_params):
+    """serve/prefill_stream_hits and serve/dup_prefill_slices reach the
+    Reporter as counters and render through the Prometheus exporter."""
+    from chainermn_tpu.observability import Reporter
+    from chainermn_tpu.tools.obs import to_prometheus
+
+    doc = prompts_for(1, rng_seed=61, lo=40, hi=41)[0]
+
+    def run(stream):
+        rep = Reporter()
+        engine = make_engine(lm, lm_params, prefill_chunk=4)
+        sched = ContinuousBatchingScheduler(engine, reporter=rep,
+                                            stream_prefix=stream)
+        sched.add_request(Request(request_id=0, prompt=list(doc),
+                                  max_new_tokens=4))
+        sched.step()
+        sched.step()
+        sched.add_request(Request(request_id=1, prompt=list(doc),
+                                  max_new_tokens=4))
+        sched.run_to_completion()
+        return rep.summary()
+
+    s_on = run(True)
+    assert s_on["counters"]["serve/prefill_stream_hits"] > 0
+    prom = to_prometheus(s_on)
+    assert 'serve/prefill_stream_hits' in prom
+    # with streaming off the duplicate work the counter exists to
+    # expose actually happens — and is counted
+    s_off = run(False)
+    assert s_off["counters"]["serve/dup_prefill_slices"] > 0
+    assert 'serve/dup_prefill_slices' in to_prometheus(s_off)
+
+
+# ---------------------------------------------------------------------------
 # Frontend: backpressure, deadlines, streaming
 # ---------------------------------------------------------------------------
 def test_frontend_backpressure_queue_full(lm, lm_params):
@@ -935,6 +1192,40 @@ def test_decode_step_collective_census_matches_golden():
     assert golden["per_axis_operand_bytes"] == {}
 
 
+def _sp_prefill_census() -> dict:
+    from chainermn_tpu.analysis.fixtures import fixture_sharded_prefill
+    from chainermn_tpu.observability import audit_fn
+
+    t = fixture_sharded_prefill()
+    audit = audit_fn(t["fn"], *t["args"])
+    return {
+        "target": t["target"],
+        "hlo_collectives": audit.census(),
+        "reduction_collectives": audit.reduction_collectives(),
+    }
+
+
+def test_sp_prefill_collective_census_matches_golden():
+    """The sequence-sharded prefill program's collective budget is
+    pinned: exactly the per-layer K/V all-gathers (pure concatenation),
+    ZERO reduction collectives — the shape of the bit-exactness
+    argument, enforced on the compiled HLO."""
+    with open(SP_CENSUS_GOLDEN_PATH) as f:
+        golden = json.load(f)
+    current = _sp_prefill_census()
+    assert current == golden, (
+        "sp-prefill collective census drifted — if a reduction crept "
+        "in, the serving plane's bit-exactness contract is broken; if "
+        "the change is an intended gather restructure, regenerate "
+        f"with: python {__file__} --regen"
+    )
+    # the golden itself must pin gathers-only (guards a bad regen)
+    assert golden["reduction_collectives"] == 0
+    assert golden["hlo_collectives"]["all_gather"] > 0
+    assert all(v == 0 for k, v in golden["hlo_collectives"].items()
+               if k != "all_gather")
+
+
 # ---------------------------------------------------------------------------
 # Subprocess smokes: bench --serve, the example
 # ---------------------------------------------------------------------------
@@ -1042,12 +1333,13 @@ def test_serving_soak_shared_prefix_spec_churn(lm, lm_params, oracle):
 # ---------------------------------------------------------------------------
 def _regen():
     jax.config.update("jax_platforms", "cpu")
-    census = _decode_census()
     os.makedirs(os.path.dirname(CENSUS_GOLDEN_PATH), exist_ok=True)
-    with open(CENSUS_GOLDEN_PATH, "w") as f:
-        json.dump(census, f, indent=2, sort_keys=True)
-        f.write("\n")
-    print(f"wrote {CENSUS_GOLDEN_PATH}", file=sys.stderr)
+    for path, census in ((CENSUS_GOLDEN_PATH, _decode_census()),
+                         (SP_CENSUS_GOLDEN_PATH, _sp_prefill_census())):
+        with open(path, "w") as f:
+            json.dump(census, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {path}", file=sys.stderr)
 
 
 if __name__ == "__main__":

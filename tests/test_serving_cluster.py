@@ -597,6 +597,55 @@ def test_replica_load_gossip_fields_roundtrip(lm, lm_params):
     assert ld_old.block_size == 0 and ld_old.prefix_digests == ()
 
 
+def test_replica_load_max_bucket_roundtrip(lm, lm_params):
+    """The warm-ladder watermark rides the load beat: after a replica
+    serves a prompt past its seed ladder, its gossiped ``max_bucket``
+    covers the full context, survives the wire dict roundtrip, and an
+    old peer's dict without the field still parses (cold: 0)."""
+    from chainermn_tpu.serving.cluster import ReplicaLoad
+
+    rep = Replica(0, make_engine(lm, lm_params, prefill_buckets=(8,)))
+    prompt = prompts_for(1, rng_seed=71, lo=20, hi=21)[0]
+    rep.frontend.submit(list(prompt), 2)
+    while rep.scheduler.has_work:
+        rep.step()
+    ld = rep.load()
+    assert ld.max_bucket >= len(prompt)
+    assert ReplicaLoad.from_dict(ld.as_dict()) == ld
+    old = {k: v for k, v in ld.as_dict().items() if k != "max_bucket"}
+    assert ReplicaLoad.from_dict(old).max_bucket == 0
+
+
+def test_router_warm_ladder_routes_long_prompts(lm, lm_params):
+    """A prompt past the seed bucket ladder prefers the replica whose
+    ladder already grew to cover it — even with ZERO shared pages: the
+    warm replica serves it without a growth recompile.  The prefix
+    cache is wiped first so only the ladder watermark can steer."""
+    reps = [Replica(i, make_engine(lm, lm_params, prefill_buckets=(8,)))
+            for i in range(2)]
+    router = ReplicaRouter(reps)
+    long0 = prompts_for(1, rng_seed=73, lo=20, hi=21)[0]
+    reps[0].frontend.submit(list(long0), 2)  # grow replica 0's ladder
+    while reps[0].scheduler.has_work:
+        reps[0].step()
+    assert reps[0].engine.max_bucket >= len(long0)
+    # no shared pages can help the score: wipe the cache, keep the
+    # ladder warm (compiled buckets are engine state, not kv state)
+    reps[0].engine.kv.drop_prefix_cache()
+    router.step()                        # load beat re-syncs the view
+    prompt = prompts_for(1, rng_seed=79, lo=12, hi=13)[0]
+    assert len(prompt) > 8               # past replica 1's cold ladder
+    h = router.submit(list(prompt), 4)
+    router.run_until_idle()
+    want = oracle_streams(lm, lm_params, [prompt], 4)[0]
+    assert h.status == "finished" and h.tokens == want
+    # otherwise-identical scores tie-break to replica 1; only the
+    # warm-ladder bonus can have pulled the placement to replica 0
+    assert h.replica_id == 0
+    for r in reps:
+        r.engine.kv.assert_consistent()
+
+
 # ---------------------------------------------------------------------------
 # Health: heartbeats, scale signals, gauges
 # ---------------------------------------------------------------------------

@@ -92,7 +92,7 @@ def flat_specs(tree):
 
 def test_registry_builtins():
     names = [p.name for p in list_plans()]
-    assert names == ["dp", "dp_tp", "fsdp", "tp", "zero"]
+    assert names == ["dp", "dp_tp", "fsdp", "sp", "tp", "zero"]
     with pytest.raises(KeyError, match="registered plans"):
         get_plan("nope")
     with pytest.raises(ValueError, match="already registered"):
@@ -376,7 +376,7 @@ def test_cli_list_show_lint():
     r = _run_cli("--list", "--format", "json")
     assert r.returncode == 0, r.stderr
     names = [p["name"] for p in json.loads(r.stdout)["plans"]]
-    assert names == ["dp", "dp_tp", "fsdp", "tp", "zero"]
+    assert names == ["dp", "dp_tp", "fsdp", "sp", "tp", "zero"]
 
     r = _run_cli("--show", "mlp", "dp")
     assert r.returncode == 0, r.stderr
