@@ -1415,7 +1415,8 @@ def _serve_traffic_point(args, model, params, spec, *, n_replicas,
 
     def submit(a):
         h = router.submit(list(a.prompt), a.max_new_tokens,
-                          timeout_s=600.0, priority=a.priority)
+                          timeout_s=600.0, priority=a.priority,
+                          tenant=a.tenant)
         handles.append(h)
         return h
 

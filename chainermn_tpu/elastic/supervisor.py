@@ -84,6 +84,10 @@ class SupervisorConfig:
     coordinator_host: str = "127.0.0.1"
     barrier_timeout_s: Optional[float] = 120.0  # exported to ranks
     init_timeout_s: float = 120.0
+    #: serve a live Prometheus /metrics scrape endpoint on this port
+    #: while the job runs (0 = ephemeral; None = off): the elastic/*
+    #: counters plus per-event-kind counts, scrapeable mid-chaos.
+    metrics_port: Optional[int] = None
 
 
 class _Rank:
@@ -127,6 +131,10 @@ class ElasticSupervisor:
         self.params_digest: Optional[str] = None
         self.events: List[dict] = []
         self._recorder = None
+        self._reporter = None
+        self._exporter = None
+        #: scrape URL once the exporter is up (config.metrics_port).
+        self.metrics_url: Optional[str] = None
         self._workdir = config.workdir or os.path.join(
             os.getcwd(), "elastic-supervisor"
         )
@@ -145,6 +153,13 @@ class ElasticSupervisor:
                  self.resume_generation or 0),
             ):
                 self._recorder.record("counter", name=name, value=value)
+        if self._reporter is not None:
+            self._reporter.count(f"elastic/events/{kind}", 1)
+            self._reporter.gauge("elastic/restarts", self.restarts)
+            self._reporter.gauge("elastic/preemptions", self.preemptions)
+            self._reporter.gauge("elastic/incarnation", self.incarnation)
+            self._reporter.gauge("elastic/resume_generation",
+                                 self.resume_generation or 0)
 
     # -- process plumbing ----------------------------------------------
     def _free_port(self) -> int:
@@ -328,6 +343,18 @@ class ElasticSupervisor:
                 cfg.step_log, capture_compile_events=False, mem_every=0,
             )
             self._recorder = recorder_cm
+        if cfg.metrics_port is not None:
+            from chainermn_tpu.observability import (
+                MetricsExporter,
+                Reporter,
+            )
+
+            self._reporter = Reporter()
+            self._exporter = MetricsExporter(
+                self._reporter, port=cfg.metrics_port
+            )
+            self._exporter.start()
+            self.metrics_url = self._exporter.url
         try:
             while True:
                 ranks = self._spawn_world(world)
@@ -380,6 +407,9 @@ class ElasticSupervisor:
             if recorder_cm is not None:
                 recorder_cm.close()
                 self._recorder = None
+            if self._exporter is not None:
+                self._exporter.stop()
+                self._exporter = None
         return report
 
 

@@ -52,6 +52,12 @@ from chainermn_tpu.observability.hlo_audit import (  # noqa: F401
     fold_async_counts,
     trace_step,
 )
+from chainermn_tpu.observability.exporter import (  # noqa: F401
+    MetricsExporter,
+)
+from chainermn_tpu.observability.anomaly import (  # noqa: F401
+    AnomalyDetector,
+)
 from chainermn_tpu.observability.spans import (  # noqa: F401
     named_scope,
     span,

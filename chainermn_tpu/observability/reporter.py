@@ -185,6 +185,30 @@ class Reporter:
             self._hists.clear()
             self._gauges.clear()
 
+    def forget_replica(self, replica_id) -> int:
+        """Drop every series labelled with ``replica_id`` (names ending
+        in ``/replica/<id>`` or containing it as a path segment).
+
+        A retired or SIGKILLed replica otherwise leaves its last
+        ``serving/*/replica/<id>`` gauges in the registry forever — an
+        operator's dashboard would show a dead replica at its final
+        (healthy-looking) levels.  Returns the number of series dropped.
+        """
+        tail = f"/replica/{replica_id}"
+        mid = tail + "/"
+
+        def stale(name: str) -> bool:
+            return name.endswith(tail) or mid in name
+
+        dropped = 0
+        with self._lock:
+            for table in (self._scalars, self._counters, self._hists,
+                          self._gauges):
+                for name in [k for k in table if stale(k)]:
+                    del table[name]
+                    dropped += 1
+        return dropped
+
     # -- cross-host ----------------------------------------------------
     def aggregate(self, comm, reset: bool = False) -> dict:
         """Merge every process's summary across ``comm``'s host plane.

@@ -354,9 +354,11 @@ class Tracer:
             rep.histogram_observe(f"trace/{name}", row["dur"])
             if row.get("error"):
                 rep.count(f"trace/{name}/errors", 1)
-            self._slo_observe(name, row["dur"], rep)
+            tenant = (row.get("attrs") or {}).get("tenant")
+            self._slo_observe(name, row["dur"], rep, tenant=tenant)
 
-    def _slo_observe(self, name: str, dur: float, rep) -> None:
+    def _slo_observe(self, name: str, dur: float, rep,
+                     tenant=None) -> None:
         slo = self.slo
         if slo is None or name not in slo.targets:
             return
@@ -366,10 +368,22 @@ class Tracer:
                 name, deque(maxlen=max(1, slo.window)))
             win.append(bad)
             frac = sum(win) / len(win)
+            tfrac = None
+            if tenant is not None:
+                # Per-tenant burn window: same SLO target and budget,
+                # windowed over THIS tenant's spans only, so one noisy
+                # tenant's violations don't hide inside the aggregate.
+                twin = self._slo_win.setdefault(
+                    (name, tenant), deque(maxlen=max(1, slo.window)))
+                twin.append(bad)
+                tfrac = sum(twin) / len(twin)
         if bad:
             rep.count(f"slo/violations/{name}", 1)
-        rep.gauge(f"slo/burn_rate/{name}",
-                  frac / slo.budget if slo.budget > 0 else 0.0)
+        scale = 1.0 / slo.budget if slo.budget > 0 else 0.0
+        rep.gauge(f"slo/burn_rate/{name}", frac * scale)
+        if tfrac is not None:
+            rep.gauge(f"slo/burn_rate/{name}/tenant/{tenant}",
+                      tfrac * scale)
 
     # -- read side -----------------------------------------------------
     def records(self) -> List[dict]:

@@ -19,6 +19,9 @@ this package turns N of them into a routed fleet:
   gossip content-addressed digests of their prefix-index keys on load
   beats (versioned anti-entropy), so routers score *remote* prefix
   hits and same-template traffic converges on the warm replica;
+* :mod:`metrics_gossip` — the fleet metrics view: Reporter snapshots
+  ride the same beats with the same strictly-newer merge, so the
+  router's ``/metrics`` endpoint serves one live fleet-wide summary;
 * :mod:`health` — heartbeat liveness and watermark-driven scale/drain
   signals as Reporter gauges, plus the hysteresis filter debouncing
   them;
@@ -52,6 +55,9 @@ from chainermn_tpu.serving.cluster.migration import (  # noqa: F401
     recv_snapshot,
     restore_sequence,
     send_snapshot,
+)
+from chainermn_tpu.serving.cluster.metrics_gossip import (  # noqa: F401
+    MetricsGossip,
 )
 from chainermn_tpu.serving.cluster.prefix_gossip import (  # noqa: F401
     PrefixGossip,

@@ -83,10 +83,17 @@ class Autoscaler:
                                                          Replica],
                  config: Optional[AutoscalerConfig] = None,
                  reporter=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 anomaly=None):
         self.router = router
         self.replica_factory = replica_factory
         self.config = config or AutoscalerConfig()
+        #: optional :class:`~chainermn_tpu.observability.anomaly.
+        #: AnomalyDetector` — while it is alarming (fleet latency
+        #: regression / goodput drop), scale-up is voted exactly like
+        #: the burn-rate override.  The caller updates the detector;
+        #: the controller only reads :meth:`alarming`.
+        self.anomaly = anomaly
         self.reporter = reporter if reporter is not None \
             else router.reporter
         self.clock = clock
@@ -176,6 +183,12 @@ class Autoscaler:
             # Latency SLO burning through budget is a scale-up vote
             # even when pages/queues look fine.
             signals = dict(signals, scale_up=True)
+        anomalous = self.anomaly is not None and self.anomaly.alarming()
+        if anomalous:
+            # Fleet-view anomaly (latency regression / goodput drop):
+            # same override as the burn guard — symptoms users see
+            # before the watermarks move.
+            signals = dict(signals, scale_up=True)
         alive = self._alive()
         if self.reporter is not None:
             self.reporter.gauge("autoscaler/replicas", alive)
@@ -204,7 +217,12 @@ class Autoscaler:
         if decision["scale_up"]:
             if alive >= c.max_replicas:
                 return None
-            reason = "burn_rate" if burn >= c.burn_limit else "watermark"
+            if burn >= c.burn_limit:
+                reason = "burn_rate"
+            elif anomalous:
+                reason = "anomaly"
+            else:
+                reason = "watermark"
             return self._spawn(now, reason=reason)
         cand = decision["drain"]
         if cand is not None and alive > c.min_replicas \

@@ -80,6 +80,14 @@ class ReplicaLoad:
     #: bucket ladder never recompiles fleet-wide.  0 = cold / snapshot
     #: from a peer predating the field (wire compat).
     max_bucket: int = 0
+    #: metrics anti-entropy stamp — monotone per replica, applied
+    #: strictly-newer-only by the router's MetricsGossip.  0 in beats
+    #: from peers predating the fleet metrics plane (wire compat).
+    metrics_version: int = 0
+    #: the replica Reporter's full cumulative summary() at snapshot
+    #: time, or None when the replica runs without a reporter / the
+    #: beat came from an old peer (wire compat).
+    metrics: Optional[dict] = None
 
     @property
     def free_frac(self) -> float:
@@ -112,12 +120,22 @@ class Replica:
                  watermark_blocks: Optional[int] = None,
                  max_queue: int = 64,
                  clock: Callable[[], float] = time.monotonic,
-                 spec_tokens: int = 0):
+                 spec_tokens: int = 0,
+                 metrics_reporter=None):
         if role not in ROLES:
             raise ValueError(f"role {role!r} not in {ROLES}")
         self.replica_id = replica_id
         self.role = role
         self.clock = clock
+        #: Reporter whose summary rides this replica's load beats into
+        #: the router's fleet view.  Deliberately separate from
+        #: ``reporter``: in-process clusters often share ONE Reporter
+        #: across replicas (and with the router), and gossiping a shared
+        #: registry would multiply every count at the merge.  Set it
+        #: only when the replica owns its registry (the multi-process
+        #: service loop does).
+        self.metrics_reporter = metrics_reporter
+        self._metrics_seq = 0
         self.scheduler = ContinuousBatchingScheduler(
             engine, watermark_blocks=watermark_blocks,
             reporter=reporter, replica=replica_id,
@@ -169,6 +187,7 @@ class Replica:
             if not h.done and h.timeout_s is not None
         ]
         st = self.engine.kv.stats()
+        metrics_version, metrics = self.metrics_beat()
         return ReplicaLoad(
             replica_id=self.replica_id,
             role=self.role,
@@ -189,7 +208,18 @@ class Replica:
                 limit=MAX_GOSSIP_DIGESTS
             )),
             max_bucket=self.engine.max_bucket,
+            metrics_version=metrics_version,
+            metrics=metrics,
         )
+
+    def metrics_beat(self) -> Tuple[int, Optional[dict]]:
+        """Freshly-stamped ``(version, summary)`` metrics payload for a
+        load beat — ``(0, None)`` when this replica gossips no metrics
+        (no :attr:`metrics_reporter`)."""
+        if self.metrics_reporter is None:
+            return 0, None
+        self._metrics_seq += 1
+        return self._metrics_seq, self.metrics_reporter.summary()
 
     # -- stepping (worker-side; callers hold self.lock) ----------------
     def step(self) -> int:

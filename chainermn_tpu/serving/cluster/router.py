@@ -49,6 +49,7 @@ from chainermn_tpu.serving.cluster.migration import (
     extract_sequence,
     restore_sequence,
 )
+from chainermn_tpu.serving.cluster.metrics_gossip import MetricsGossip
 from chainermn_tpu.serving.cluster.prefix_gossip import PrefixGossip
 from chainermn_tpu.serving.cluster.replica import Replica, ReplicaLoad
 from chainermn_tpu.serving.engine import SamplingParams
@@ -78,6 +79,9 @@ class ClusterHandle:
     failovers: int = 0
     #: shed class (0 = most important) — travels with every placement.
     priority: int = 0
+    #: accounting identity — travels with every placement so tenant
+    #: counters survive migration/failover.
+    tenant: Optional[str] = None
     #: times this stream moved replicas via live KV-page migration
     #: (scale-down drains; distinct from failover replays).
     migrations: int = 0
@@ -145,6 +149,9 @@ class ReplicaRouter:
         #: placement sees remote prefix hits even when the direct probe
         #: below is unavailable or the view is one beat stale.
         self.gossip = PrefixGossip()
+        #: fleet metrics view: latest Reporter snapshot per replica,
+        #: folded at the same beat cadence and served via fleet_view().
+        self.metrics = MetricsGossip()
 
     # -- scoring -------------------------------------------------------
     @staticmethod
@@ -278,6 +285,7 @@ class ReplicaRouter:
                timeout_s: Optional[float] = None,
                on_token: Optional[Callable[[int, int], None]] = None,
                priority: int = 0,
+               tenant: Optional[str] = None,
                ) -> ClusterHandle:
         """Route one request; raises :class:`QueueFull` (with the
         minimum retry-after hint across replicas) when no replica
@@ -297,14 +305,17 @@ class ReplicaRouter:
             submitted_at=self.clock(),
             on_token=on_token,
             priority=int(priority),
+            tenant=tenant,
         )
         self._handles[gid] = handle
         tr = _tracing.get_tracer()
         if tr is not None:
+            root_attrs = dict(rid=gid, prompt_len=len(handle.prompt),
+                              max_new_tokens=handle.max_new_tokens)
+            if tenant is not None:
+                root_attrs["tenant"] = tenant
             handle._trace_root = tr.begin(
-                "request", replica="router", rid=gid,
-                prompt_len=len(handle.prompt),
-                max_new_tokens=handle.max_new_tokens,
+                "request", replica="router", **root_attrs
             )
             handle.trace_id = handle._trace_root.trace_id
         try:
@@ -395,6 +406,7 @@ class ReplicaRouter:
                 committed=committed,
                 trace=root,
                 priority=handle.priority,
+                tenant=handle.tenant,
             )
         if tr is not None and root is not None:
             tr.record_span("placement", root, t0, tr.clock() - t0,
@@ -503,6 +515,11 @@ class ReplicaRouter:
         if self.health is not None:
             self.health.mark_dead(replica_id)
         self.gossip.forget(replica_id)
+        self.metrics.forget(replica_id)
+        if self.reporter is not None:
+            # stale-series fix: the victim's last serving/*/replica/<id>
+            # gauges must not outlive it on the router's own registry
+            self.reporter.forget_replica(replica_id)
         moved = 0
         # 1. Streaming requests placed on the dead replica: re-place
         #    with their committed prefix.
@@ -573,6 +590,8 @@ class ReplicaRouter:
                     rep.replica_id, kv.index_version,
                     kv.prefix_digests(),
                 )
+                mv, ms = rep.metrics_beat()
+                self.metrics.observe(rep.replica_id, mv, ms)
         self._collect_handoffs()
         self._place_handoffs()
         self._sync(now)
@@ -779,6 +798,7 @@ class ReplicaRouter:
                     committed=list(handle.tokens),
                     trace=handle._trace_root,
                     priority=handle.priority,
+                    tenant=handle.tenant,
                 )
         except QueueFull as e:
             handle.status = "failed"
@@ -833,6 +853,7 @@ class ReplicaRouter:
                     on_token=lambda _rid, tok: handle._commit(tok),
                     trace=handle._trace_root,
                     priority=handle.priority,
+                    tenant=handle.tenant,
                 )
                 req2.generated = list(req.generated)
                 target.frontend.adopt(
@@ -876,12 +897,24 @@ class ReplicaRouter:
             rep.alive = False
         del self.replicas[replica_id]
         self.gossip.forget(replica_id)
+        self.metrics.forget(replica_id)
         if self.health is not None:
             self.health.forget(replica_id)
         if self.reporter is not None:
+            self.reporter.forget_replica(replica_id)
             self.reporter.count("serving/cluster/replicas_retired", 1)
         return True
 
     def loads(self, now: Optional[float] = None) -> List[ReplicaLoad]:
         now = self.clock() if now is None else now
         return [r.load(now) for r in self.replicas.values()]
+
+    def fleet_view(self) -> dict:
+        """The merged fleet summary — the router's own Reporter plus the
+        latest gossiped snapshot of every live replica.  This is what a
+        router-attached :class:`MetricsExporter` serves: one scrape
+        covers the fleet, and a forgotten replica's series are already
+        gone."""
+        extra = ([self.reporter.summary()]
+                 if self.reporter is not None else [])
+        return self.metrics.fleet_view(extra=extra)

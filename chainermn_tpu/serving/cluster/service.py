@@ -51,7 +51,10 @@ from typing import Dict, List, Optional
 
 from chainermn_tpu.communicators.kvtransport import ObjectPlane, PeerGone
 from chainermn_tpu.observability import tracing as _tracing
+from chainermn_tpu.observability.exporter import MetricsExporter
+from chainermn_tpu.observability.reporter import Reporter
 from chainermn_tpu.serving.cluster.health import HeartbeatMonitor
+from chainermn_tpu.serving.cluster.metrics_gossip import MetricsGossip
 from chainermn_tpu.serving.cluster.prefix_gossip import PrefixGossip
 from chainermn_tpu.serving.cluster.replica import Replica, ReplicaLoad
 from chainermn_tpu.serving.cluster.router import ReplicaRouter
@@ -84,7 +87,8 @@ def run_replica(rank: int, size: int, engine_factory,
                 kill_after_tokens: Optional[int] = None,
                 plane: Optional[ObjectPlane] = None,
                 flight_path: Optional[str] = None,
-                spec_tokens: int = 0) -> dict:
+                spec_tokens: int = 0,
+                metrics_port: Optional[int] = None) -> dict:
     """Serve as replica ``rank`` until the router says stop (or the
     router's edge dies).  ``engine_factory()`` builds the
     InferenceEngine (model + params + config) — construction is the
@@ -94,7 +98,12 @@ def run_replica(rank: int, size: int, engine_factory,
 
     ``flight_path`` — install a tracer backed by a crash-surviving
     :class:`FlightRecorder` at that path for the duration (no-op when a
-    tracer is already installed; the already-installed one wins)."""
+    tracer is already installed; the already-installed one wins).
+
+    ``metrics_port`` — serve this replica's Reporter at
+    ``http://127.0.0.1:<port>/metrics`` for the duration (0 = ephemeral
+    port).  The same Reporter's summary always rides the load beats
+    into the router's fleet view, exporter or not."""
     tr = None
     if flight_path is not None and _tracing.get_tracer() is None:
         tr = _tracing.Tracer(
@@ -102,13 +111,20 @@ def run_replica(rank: int, size: int, engine_factory,
             replica=rank,
         )
         _tracing.install(tr)
+    reporter = Reporter()
+    exporter = None
+    if metrics_port is not None:
+        exporter = MetricsExporter(reporter, port=metrics_port)
+        exporter.start()
     try:
         return _run_replica_inner(
             rank, size, engine_factory, role, max_queue,
             watermark_blocks, heartbeat_s, kill_after_tokens, plane,
-            spec_tokens,
+            spec_tokens, reporter,
         )
     finally:
+        if exporter is not None:
+            exporter.stop()
         if tr is not None:
             _tracing.uninstall(tr)
             tr.close()
@@ -116,7 +132,8 @@ def run_replica(rank: int, size: int, engine_factory,
 
 def _run_replica_inner(rank, size, engine_factory, role, max_queue,
                        watermark_blocks, heartbeat_s,
-                       kill_after_tokens, plane, spec_tokens=0) -> dict:
+                       kill_after_tokens, plane, spec_tokens=0,
+                       reporter=None) -> dict:
     import os
     import signal
 
@@ -132,6 +149,9 @@ def _run_replica_inner(rank, size, engine_factory, role, max_queue,
         rank, engine_factory(), role=role,
         watermark_blocks=watermark_blocks, max_queue=max_queue,
         spec_tokens=spec_tokens,
+        # This process OWNS its registry, so it both publishes into it
+        # and gossips it to the router on every load beat.
+        reporter=reporter, metrics_reporter=reporter,
     )
     outbox: List[tuple] = []
     gid_of_local: Dict[int, int] = {}
@@ -164,6 +184,9 @@ def _run_replica_inner(rank, size, engine_factory, role, max_queue,
                     on_token=on_token_for(gid),
                     committed=msg["committed"],
                     trace=ctx,
+                    # .get(): wire compat with routers predating the
+                    # tenant accounting field.
+                    tenant=msg.get("tenant"),
                 )
             except QueueFull as e:
                 outbox.append(("reject", gid, e.retry_after_s))
@@ -335,7 +358,9 @@ def run_router(size: int, requests: List[dict],
                reporter=None,
                plane: Optional[ObjectPlane] = None,
                flight_path: Optional[str] = None,
-               slo=None) -> Dict[int, dict]:
+               slo=None,
+               metrics_port: Optional[int] = None,
+               metrics_port_file: Optional[str] = None) -> Dict[int, dict]:
     """Drive ``requests`` (dicts: prompt, max_new_tokens, optional
     sampling/stop_token/timeout_s) to completion over replicas at
     subgroup ranks ``1..size-1``.  Returns ``{gid: {"tokens": [...],
@@ -350,7 +375,14 @@ def run_router(size: int, requests: List[dict],
     ``slo`` — an :class:`~chainermn_tpu.observability.tracing.SLOConfig`;
     installs a tracer (even without ``flight_path``) wired to
     ``reporter`` so ``slo/burn_rate/<stage>`` gauges accumulate on the
-    router, where stage spans from every replica converge."""
+    router, where stage spans from every replica converge.
+
+    ``metrics_port`` — serve the merged FLEET view (the router's own
+    Reporter plus the heartbeat-gossiped snapshot of every live
+    replica) at ``http://127.0.0.1:<port>/metrics``; 0 binds an
+    ephemeral port, and ``metrics_port_file`` (written once, atomically
+    enough for a poll loop: temp file + rename) tells an external
+    scraper which port was bound."""
     tr = None
     if (flight_path is not None or slo is not None) \
             and _tracing.get_tracer() is None:
@@ -363,12 +395,33 @@ def run_router(size: int, requests: List[dict],
             reporter=reporter, slo=slo,
         )
         _tracing.install(tr)
+    if metrics_port is None and metrics_port_file is not None:
+        metrics_port = 0
+    metrics = MetricsGossip()
+    exporter = None
+    if metrics_port is not None:
+        if reporter is None:
+            reporter = Reporter()  # the fleet view needs a registry
+
+        def fleet_view(reporter=reporter, metrics=metrics) -> dict:
+            return metrics.fleet_view(extra=[reporter.summary()])
+
+        exporter = MetricsExporter(fleet_view, port=metrics_port)
+        bound = exporter.start()
+        if metrics_port_file is not None:
+            import os
+            tmp = f"{metrics_port_file}.tmp"
+            with open(tmp, "w") as f:
+                f.write(str(bound))
+            os.replace(tmp, metrics_port_file)
     try:
         return _run_router_inner(
             size, requests, prefill_threshold, roles, miss_after_s,
-            timeout_s, reporter, plane,
+            timeout_s, reporter, plane, metrics,
         )
     finally:
+        if exporter is not None:
+            exporter.stop()
         if tr is not None:
             _tracing.uninstall(tr)
             tr.close()
@@ -376,7 +429,7 @@ def run_router(size: int, requests: List[dict],
 
 def _run_router_inner(size, requests, prefill_threshold, roles,
                       miss_after_s, timeout_s, reporter,
-                      plane) -> Dict[int, dict]:
+                      plane, metrics=None) -> Dict[int, dict]:
     plane = plane or _mk_plane(0, size)
     tr = _tracing.get_tracer()
     replica_ranks = list(range(1, size))
@@ -392,6 +445,9 @@ def _run_router_inner(size, requests, prefill_threshold, roles,
     # pick_replica below can score a prompt's prefix affinity for
     # replicas this router has never sent it to.
     gossip = PrefixGossip()
+    # Fleet metrics view: Reporter snapshots ride the same beats with
+    # the same strictly-newer anti-entropy (cluster/metrics_gossip.py).
+    metrics = metrics if metrics is not None else MetricsGossip()
     reqs: Dict[int, _RemoteRequest] = {}
     pending: List[_RemoteRequest] = []
     prefilling: Dict[int, int] = {}  # gid -> prefill replica
@@ -416,12 +472,15 @@ def _run_router_inner(size, requests, prefill_threshold, roles,
         # arrives mid-prefill and must be routed by the gossiped
         # partial-prefix view alone.
         spec.setdefault("after_index_pages", None)
+        # Accounting identity (per-tenant counters + SLO burn).
+        spec.setdefault("tenant", None)
         rr = _RemoteRequest(gid, spec)
         if tr is not None:
-            rr.trace = tr.begin(
-                "request", rid=gid, prompt_len=len(spec["prompt"]),
-                max_new_tokens=spec["max_new_tokens"],
-            )
+            root_attrs = dict(rid=gid, prompt_len=len(spec["prompt"]),
+                              max_new_tokens=spec["max_new_tokens"])
+            if spec["tenant"] is not None:
+                root_attrs["tenant"] = spec["tenant"]
+            rr.trace = tr.begin("request", **root_attrs)
         reqs[gid] = rr
         pending.append(rr)
 
@@ -498,6 +557,7 @@ def _run_router_inner(size, requests, prefill_threshold, roles,
             "timeout_s": rr.spec["timeout_s"],
             "committed": list(rr.tokens),
             "trace": wire_trace(rr),
+            "tenant": rr.spec["tenant"],
         })
         if ok:
             if tr is not None and rr.trace is not None:
@@ -515,6 +575,12 @@ def _run_router_inner(size, requests, prefill_threshold, roles,
         alive.discard(rank)
         health.mark_dead(rank)
         gossip.forget(rank)
+        # The dead replica's snapshot — and with it every one of its
+        # per-replica series — leaves the fleet view immediately; its
+        # router-side gauges go with it (stale-series fix).
+        metrics.forget(rank)
+        if reporter is not None:
+            reporter.forget_replica(rank)
         for gid in sorted(assigned.pop(rank, set())):
             rr = reqs[gid]
             if rr.done:
@@ -627,6 +693,8 @@ def _run_router_inner(size, requests, prefill_threshold, roles,
                 roles[rank] = loads[rank].role
                 gossip.observe(rank, loads[rank].prefix_version,
                                loads[rank].prefix_digests)
+                metrics.observe(rank, loads[rank].metrics_version,
+                                loads[rank].metrics)
 
     deadline = time.monotonic() + timeout_s
     while any(not rr.done for rr in reqs.values()):

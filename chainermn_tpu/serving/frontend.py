@@ -216,6 +216,8 @@ class ServeFrontend:
             self._close_trace(h)
         if self.reporter is not None:
             self.reporter.count(f"serve/shed/{victim.priority}", 1)
+            if victim.tenant is not None:
+                self.reporter.count(f"tenant/{victim.tenant}/shed", 1)
         return True
 
     def submit(self, prompt, max_new_tokens: int,
@@ -227,6 +229,7 @@ class ServeFrontend:
                trace=None,
                speculative: bool = True,
                priority: int = 0,
+               tenant: Optional[str] = None,
                ) -> RequestHandle:
         """Enqueue one request; raises :class:`QueueFull` (with a
         ``retry_after_s`` hint once throughput is known) when the
@@ -248,7 +251,11 @@ class ServeFrontend:
         ``priority`` — the request's shed class (0 = most important).
         At capacity the arrival first tries to shed one strictly
         lower-class waiting request; only when no such victim exists
-        does it see :class:`QueueFull` itself."""
+        does it see :class:`QueueFull` itself.
+
+        ``tenant`` — accounting identity: admits/sheds/rejects and
+        token flow are additionally counted under ``tenant/<id>/*``
+        (None = untenanted, no extra series)."""
         priority = int(priority)
         if self.queue_depth() >= self.max_queue and not self._shed_one(
             priority, self.clock()
@@ -259,6 +266,8 @@ class ServeFrontend:
                 msg += f"; retry after ~{hint:.3f}s"
             if self.reporter is not None:
                 self.reporter.count(f"serve/rejected/{priority}", 1)
+                if tenant is not None:
+                    self.reporter.count(f"tenant/{tenant}/rejected", 1)
             raise QueueFull(msg, retry_after_s=hint)
         rid = self.reserve_id()
         req = Request(
@@ -270,9 +279,14 @@ class ServeFrontend:
             on_token=on_token,
             speculative=speculative,
             priority=priority,
+            tenant=tenant,
         )
         if self.reporter is not None:
             self.reporter.count(f"serve/admit/{priority}", 1)
+            if tenant is not None:
+                self.reporter.count(f"tenant/{tenant}/admit", 1)
+                self.reporter.count(f"tenant/{tenant}/tokens_in",
+                                    len(req.prompt))
         if committed:
             req.generated = list(map(int, committed))
         handle = RequestHandle(
@@ -286,10 +300,14 @@ class ServeFrontend:
             parent = _tracing.SpanCtx.from_wire(trace)
             if parent is None:
                 # This frontend is the entry point: mint the root.
-                handle._trace_root = tr.begin(
-                    "request", replica=self.replica, rid=rid,
-                    prompt_len=len(req.prompt),
+                root_attrs = dict(
+                    rid=rid, prompt_len=len(req.prompt),
                     max_new_tokens=req.max_new_tokens,
+                )
+                if tenant is not None:
+                    root_attrs["tenant"] = tenant
+                handle._trace_root = tr.begin(
+                    "request", replica=self.replica, **root_attrs
                 )
                 parent = handle._trace_root
             handle.trace_id = parent.trace_id

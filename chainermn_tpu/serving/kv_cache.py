@@ -44,8 +44,9 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import time
 from collections import OrderedDict
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -118,7 +119,8 @@ class PagedKVCache:
     """
 
     def __init__(self, n_blocks: int, block_size: int, *,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 clock: Optional[Callable[[], float]] = None):
         if n_blocks <= 0 or block_size <= 0:
             raise ValueError("n_blocks and block_size must be positive")
         self.n_blocks = int(n_blocks)
@@ -150,6 +152,21 @@ class PagedKVCache:
         #: (old, new) CoW splits performed by the most recent
         #: :meth:`make_writable` (the engine copies the device page).
         self._last_cow_split: Optional[Tuple[int, int]] = None
+        # Per-tenant page-second accounting.  Every page with refcount
+        # ≥ 1 has exactly one OWNER — the tenant whose sequence first
+        # pulled it to refcount 1 (shared prefix pages accrue to their
+        # first owner only, never double-billed).  Accrual happens
+        # lazily: every mutating entry point calls :meth:`_accrue`
+        # BEFORE changing any hold count, so each tenant's integral is
+        # exact and the sum over tenants (incl. the ``None`` bucket for
+        # untenanted sequences) equals the pool's used-page integral by
+        # construction.  ``clock`` is injectable for deterministic tests.
+        self._clock = clock if clock is not None else time.monotonic
+        self._ps_last = self._clock()
+        self._page_owner: Dict[int, Optional[str]] = {}
+        self._held: Dict[Optional[str], int] = {}
+        self._page_seconds: Dict[Optional[str], float] = {}
+        self._seq_tenant: Dict[object, Optional[str]] = {}
 
     # -- sizing --------------------------------------------------------
     def blocks_for(self, n_tokens: int) -> int:
@@ -267,6 +284,50 @@ class PagedKVCache:
             del self._index[key]
             self._index_version += 1
 
+    # -- per-tenant page-seconds ---------------------------------------
+    def _accrue(self, now: Optional[float] = None) -> float:
+        """Integrate held-page time up to ``now`` into each owner's
+        page-second bucket.  Called at the top of every mutating entry
+        point (before hold counts change), so the integrals are exact."""
+        now = self._clock() if now is None else now
+        dt = now - self._ps_last
+        if dt > 0:
+            for ten, cnt in self._held.items():
+                if cnt:
+                    self._page_seconds[ten] = (
+                        self._page_seconds.get(ten, 0.0) + cnt * dt
+                    )
+        self._ps_last = now
+        return now
+
+    def _hold(self, page: int, tenant: Optional[str]) -> None:
+        """Record ``tenant`` as the owner of ``page`` — called exactly
+        when the page's refcount rises from 0 (free/cached) to 1."""
+        self._page_owner[page] = tenant
+        self._held[tenant] = self._held.get(tenant, 0) + 1
+
+    def _unhold(self, page: int) -> None:
+        ten = self._page_owner.pop(page)
+        self._held[ten] -= 1
+
+    def page_seconds(self, now: Optional[float] = None
+                     ) -> Dict[str, float]:
+        """Per-tenant KV residency integral: {tenant: page·seconds held
+        so far}.  Untenanted holdings are excluded here but still count
+        toward :meth:`pool_page_seconds`, so with all-tenanted traffic
+        ``sum(page_seconds().values()) == pool_page_seconds()``
+        exactly."""
+        self._accrue(now)
+        return {str(t): v for t, v in self._page_seconds.items()
+                if t is not None}
+
+    def pool_page_seconds(self, now: Optional[float] = None) -> float:
+        """The pool's used-page integral ∫ used_blocks dt — by
+        construction the exact sum of every owner bucket (tenanted and
+        untenanted)."""
+        self._accrue(now)
+        return sum(self._page_seconds.values())
+
     def _release(self, page: int) -> None:
         """Drop one reference; at zero the page parks in the cached pool
         (if registered) or returns to the free list."""
@@ -274,6 +335,7 @@ class PagedKVCache:
         if self._ref[page] > 0:
             return
         del self._ref[page]
+        self._unhold(page)
         if page in self._index_key_of:
             self._cached[page] = None  # most-recently released
         else:
@@ -292,14 +354,19 @@ class PagedKVCache:
 
     # -- alloc/extend/free ---------------------------------------------
     def allocate(self, seq_id, n_tokens: int,
-                 prefix_pages: Optional[Sequence[int]] = None) -> List[int]:
+                 prefix_pages: Optional[Sequence[int]] = None,
+                 tenant: Optional[str] = None) -> List[int]:
         """Create a sequence covering ``n_tokens`` positions; returns its
         block table (also readable via :meth:`block_table`).
 
         ``prefix_pages`` — a :meth:`match_prefix` result for this
         sequence's leading tokens — become the table's head *shared*:
         each gains a reference (cached pages are resurrected from the
-        pool), and only the remaining suffix draws fresh pages."""
+        pool), and only the remaining suffix draws fresh pages.
+
+        ``tenant`` — accounting identity pages first held by this
+        sequence accrue page-seconds under (:meth:`page_seconds`)."""
+        self._accrue()
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id!r} already allocated")
         prefix = [int(p) for p in (prefix_pages or [])]
@@ -324,19 +391,24 @@ class PagedKVCache:
         for p in prefix:
             if p in self._cached:
                 del self._cached[p]
+            if self._ref.get(p, 0) == 0:
+                self._hold(p, tenant)
             self._ref[p] = self._ref.get(p, 0) + 1
         fresh = [self._pop_page() for _ in range(need)]
         for p in fresh:
             self._ref[p] = 1
+            self._hold(p, tenant)
         table = prefix + fresh
         self._tables[seq_id] = table
         self._lens[seq_id] = int(n_tokens)
+        self._seq_tenant[seq_id] = tenant
         return list(table)
 
     def extend(self, seq_id, new_len: int) -> List[int]:
         """Grow ``seq_id`` to cover ``new_len`` positions; returns the
         newly allocated page ids (often empty — growth only crosses a
         page boundary every ``block_size`` tokens)."""
+        self._accrue()
         table = self._tables[seq_id]
         need = self.blocks_for(new_len) - len(table)
         if need > self._reclaimable():
@@ -344,9 +416,11 @@ class PagedKVCache:
                 f"extending {seq_id!r} to {new_len} tokens needs {need} "
                 f"pages, {self._reclaimable()} reclaimable"
             )
+        tenant = self._seq_tenant.get(seq_id)
         fresh = [self._pop_page() for _ in range(max(0, need))]
         for p in fresh:
             self._ref[p] = 1
+            self._hold(p, tenant)
         table.extend(fresh)
         self._lens[seq_id] = max(self._lens[seq_id], int(new_len))
         return fresh
@@ -356,6 +430,7 @@ class PagedKVCache:
         releasing trailing pages (speculative verify over-extends by the
         draft length, then gives back what the accepted run didn't
         need).  Returns how many pages were released."""
+        self._accrue()
         table = self._tables[seq_id]
         keep = self.blocks_for(new_len)
         dropped = 0
@@ -369,8 +444,10 @@ class PagedKVCache:
         """Detach every page of ``seq_id`` (shared pages drop one
         reference; sole-owner registered pages park in the cached pool);
         returns how many pages were detached."""
+        self._accrue()
         table = self._tables.pop(seq_id)
         self._lens.pop(seq_id)
+        self._seq_tenant.pop(seq_id, None)
         for page in reversed(table):
             self._release(page)
         return len(table)
@@ -389,6 +466,7 @@ class PagedKVCache:
         parked) and releases the sequence's own page, so the pool never
         grows — adoption cannot raise :class:`OutOfBlocks`.  Returns how
         many table entries were swapped."""
+        self._accrue()
         table = self._tables[seq_id]
         pages = [int(p) for p in pages]
         if len(pages) > len(table):
@@ -409,6 +487,8 @@ class PagedKVCache:
             # no eviction can run between the two halves.
             if page in self._cached:
                 del self._cached[page]
+            if self._ref.get(page, 0) == 0:
+                self._hold(page, self._seq_tenant.get(seq_id))
             self._ref[page] = self._ref.get(page, 0) + 1
             self._release(table[i])
             table[i] = page
@@ -428,6 +508,7 @@ class PagedKVCache:
         writes land in fresh suffix pages).  May raise
         :class:`OutOfBlocks`; the scheduler's preemption loop handles it
         like any allocation failure."""
+        self._accrue()
         table = self._tables[seq_id]
         idx = int(position) // self.block_size
         old = table[idx]
@@ -438,6 +519,7 @@ class PagedKVCache:
         self._release(old)  # registered sole-owner pages park, shared drop a ref
         table[idx] = new
         self._ref[new] = 1
+        self._hold(new, self._seq_tenant.get(seq_id))
         self._last_cow_split = (old, new)
         return (old, new)
 
@@ -527,6 +609,18 @@ class PagedKVCache:
             raise AssertionError(
                 f"refcount drift: tracked {self._ref} != actual {tabled}"
             )
+        if set(self._page_owner) != set(self._ref):
+            raise AssertionError(
+                "page-second ownership drift: owners "
+                f"{sorted(self._page_owner)} != held {sorted(self._ref)}"
+            )
+        held: Dict[Optional[str], int] = {}
+        for ten in self._page_owner.values():
+            held[ten] = held.get(ten, 0) + 1
+        if held != {t: c for t, c in self._held.items() if c}:
+            raise AssertionError(
+                f"per-tenant hold-count drift: {self._held} != {held}"
+            )
         for seq_id, table in self._tables.items():
             if len(table) != self.blocks_for(self._lens[seq_id]):
                 raise AssertionError(
@@ -589,6 +683,9 @@ class PagedKVCache:
         for table in self._tables.values():
             table[:] = [new_of_old[b] for b in table]
         self._ref = {new_of_old[p]: c for p, c in self._ref.items()}
+        self._page_owner = {
+            new_of_old[p]: t for p, t in self._page_owner.items()
+        }
         self._index = {k: new_of_old[p] for k, p in self._index.items()}
         self._index_key_of = {
             new_of_old[p]: k for p, k in self._index_key_of.items()

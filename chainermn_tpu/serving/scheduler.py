@@ -71,6 +71,9 @@ class Request:
     #: The frontend's overload policy sheds the numerically largest
     #: class first — scheduling order itself stays FCFS (Orca-style).
     priority: int = 0
+    #: accounting identity: token counters and KV page-seconds are
+    #: attributed under ``tenant/<id>/*`` (None = untenanted).
+    tenant: Optional[str] = None
     #: host step index at which the first token appeared (TTFT proxy).
     first_token_step: Optional[int] = None
     #: trace context stage spans parent to (the request's ROOT — see
@@ -242,7 +245,8 @@ class ContinuousBatchingScheduler:
                 break
             self.waiting.popleft()
             self.engine.kv.allocate(req.request_id, ctx,
-                                    prefix_pages=prefix)
+                                    prefix_pages=prefix,
+                                    tenant=req.tenant)
             req.prefix_hit_tokens = (
                 len(prefix) * self.engine.kv.block_size
             )
@@ -295,6 +299,8 @@ class ContinuousBatchingScheduler:
         req.generated.append(token)
         if req.first_token_step is None:
             req.first_token_step = self._step
+        if req.tenant is not None and self.reporter is not None:
+            self.reporter.count(f"tenant/{req.tenant}/tokens_out", 1)
         if tr is not None and req.trace is not None:
             tr.token(req.trace)
         if req.on_token is not None:
@@ -645,6 +651,19 @@ class ContinuousBatchingScheduler:
                         )
             if emitted:
                 self.reporter.count("serving/tokens", emitted)
+            # Per-tenant KV residency: page-seconds integrated by the
+            # cache itself (sum over tenants == the pool's used-page
+            # integral, exactly — conservation is by construction).
+            tenant_ps = self.engine.kv.page_seconds()
+            if tenant_ps:
+                for ten, ps in tenant_ps.items():
+                    self.reporter.gauge(
+                        f"tenant/{ten}/kv_page_seconds", ps
+                    )
+                self.reporter.gauge(
+                    f"serving/kv_page_seconds{sfx}",
+                    self.engine.kv.pool_page_seconds(),
+                )
         return emitted
 
     # -- driving -------------------------------------------------------

@@ -107,11 +107,20 @@ class TrafficSpec:
     long_frac: float = 0.0
     doc_templates: int = 4
     long_buckets: Buckets = ()
+    #: multi-tenant dimension (off by default — with ``tenants=0`` the
+    #: arrival stream is byte-identical to pre-tenant specs).  Each
+    #: arrival carries a tenant id ``"t<k>"`` drawn Zipf-popular with
+    #: exponent ``tenant_zipf`` from a CHILD generator, so a handful of
+    #: tenants dominate token flow and page residency — the regime the
+    #: per-tenant accounting (``tenant/<id>/*`` counters, KV
+    #: page-seconds) is built to attribute.
+    tenants: int = 0
+    tenant_zipf: float = 1.1
 
     _INT = ("seed", "requests", "templates", "prefix_len", "vocab",
-            "doc_templates")
+            "doc_templates", "tenants")
     _FLOAT = ("rate", "burst", "p_burst", "p_calm", "zipf_s",
-              "abusive_frac", "long_frac")
+              "abusive_frac", "long_frac", "tenant_zipf")
 
     @classmethod
     def parse(cls, text: str) -> "TrafficSpec":
@@ -185,6 +194,9 @@ class Arrival:
     #: document (``template`` then indexes past ``spec.templates`` into
     #: the document id space).
     long: bool = False
+    #: accounting identity ("t<k>"), or None when the tenant dimension
+    #: is off.
+    tenant: Optional[str] = None
 
 
 def generate(spec: TrafficSpec) -> List[Arrival]:
@@ -227,6 +239,15 @@ def generate(spec: TrafficSpec) -> List[Arrival]:
         doc_w /= doc_w.sum()
         lw = np.array([w for _, _, w in spec.long_buckets], float)
         lw /= lw.sum()
+    # Tenant dimension: its own child generator for the same reason —
+    # toggling tenancy (or resizing the tenant pool) never perturbs the
+    # base arrival stream.
+    trng = np.random.default_rng((spec.seed, 0x7E7))
+    tenant_w = None
+    if spec.tenants > 0:
+        tenant_w = np.array([1.0 / (k + 1) ** spec.tenant_zipf
+                             for k in range(spec.tenants)])
+        tenant_w /= tenant_w.sum()
 
     arrivals: List[Arrival] = []
     t, burst = 0.0, False
@@ -260,9 +281,13 @@ def generate(spec: TrafficSpec) -> List[Arrival]:
         out_len = int(rng.integers(lo, hi + 1))
         abusive = bool(rng.random() < spec.abusive_frac)
         prio = len(cw) - 1 if abusive else int(rng.choice(len(cw), p=cw))
+        tenant = None
+        if tenant_w is not None:
+            tenant = f"t{int(trng.choice(spec.tenants, p=tenant_w))}"
         arrivals.append(Arrival(
             index=i, t=t, prompt=prompt, max_new_tokens=out_len,
             priority=prio, abusive=abusive, template=tmpl, long=long,
+            tenant=tenant,
         ))
     return arrivals
 
@@ -400,8 +425,33 @@ def summarize(report: ReplayReport) -> dict:
             "shed": sum(1 for o in of_c if o.shed),
             "rejected": sum(1 for o in of_c if o.rejected),
         }
+    # Per-tenant curves (only when the tenant dimension is on): offered
+    # / finished / shed / rejected counts, finished-stream tokens, and
+    # the tenant's own p99 — the report half of the per-tenant
+    # accounting plane.
+    tenants = sorted({o.arrival.tenant for o in outs
+                      if o.arrival.tenant is not None})
+    per_tenant = {}
+    for ten in tenants:
+        of_t = [o for o in outs if o.arrival.tenant == ten]
+        fin_t = [o for o in of_t if o.finished]
+        lats_t = sorted(
+            o.finish_t - o.submit_t for o in fin_t
+            if o.finish_t is not None and o.submit_t is not None
+        )
+        per_tenant[ten] = {
+            "offered": len(of_t),
+            "finished": len(fin_t),
+            "shed": sum(1 for o in of_t if o.shed),
+            "rejected": sum(1 for o in of_t if o.rejected),
+            "tokens": sum(len(o.handle.tokens) for o in fin_t),
+            "latency_p99_s": (
+                lats_t[min(len(lats_t) - 1, int(0.99 * len(lats_t)))]
+                if lats_t else None
+            ),
+        }
     goodput_tokens = sum(len(o.handle.tokens) for o in fin)
-    return {
+    out = {
         "offered": len(outs),
         "finished": len(fin),
         "rejected": sum(1 for o in outs if o.rejected),
@@ -415,3 +465,6 @@ def summarize(report: ReplayReport) -> dict:
         "per_class": per_class,
         "retries": sum(max(0, o.attempts - 1) for o in outs),
     }
+    if per_tenant:
+        out["per_tenant"] = per_tenant
+    return out

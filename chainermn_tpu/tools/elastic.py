@@ -68,6 +68,10 @@ def main(argv=None) -> int:
     ap.add_argument("--step-log", default=None, metavar="PATH",
                     help="write supervisor events + elastic/* counters "
                          "as a JSONL step-event log")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve a live Prometheus /metrics endpoint of "
+                         "the supervisor's elastic/* counters on this "
+                         "port while the job runs (0 = ephemeral)")
     ap.add_argument("--no-echo", action="store_true",
                     help="don't mirror rank output to stdout")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
@@ -100,6 +104,7 @@ def main(argv=None) -> int:
         workdir=args.workdir,
         step_log=args.step_log,
         echo=not args.no_echo,
+        metrics_port=args.metrics_port,
     )
     report = ElasticSupervisor(config).run()
     print(main_report_line(report))

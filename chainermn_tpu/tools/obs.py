@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 from typing import Dict, List
 
@@ -303,6 +304,41 @@ def to_prometheus(summary: dict, prefix: str = "chainermn_tpu") -> str:
                "Named counters, last value per rank summed across ranks",
                [(((("name", k),)), v)
                 for k, v in sorted(counters.items())])
+    hists = summary.get("histograms")
+    if hists:
+        # Native Prometheus histogram exposition from the Reporter's
+        # power-of-two buckets: bucket b covers (2^(b-1), 2^b], so every
+        # upper bound is an exact le=2^b boundary.  Counts are cumulative
+        # per the exposition rules; _sum is the upper-bound estimate —
+        # the tightest sum a bucketed-only registry can offer.
+        lines.append(f"# HELP {prefix}_histogram "
+                     "Power-of-two histograms (bucket b covers "
+                     "(2^(b-1), 2^b])")
+        lines.append(f"# TYPE {prefix}_histogram histogram")
+
+        def hist_labels(name):
+            base, sep, rid = name.rpartition("/replica/")
+            if sep and rid:
+                return f'name="{base}",replica="{rid}"'
+            return f'name="{name}"'
+
+        for hname, bucketed in sorted(hists.items()):
+            lab = hist_labels(hname)
+            cum = 0
+            total = 0.0
+            for b, c in sorted((int(b), int(c))
+                               for b, c in bucketed.items()):
+                cum += c
+                total += c * (2.0 ** b)
+                lines.append(
+                    f'{prefix}_histogram_bucket{{{lab},'
+                    f'le="{_fmt(2.0 ** b)}"}} {cum}'
+                )
+            lines.append(
+                f'{prefix}_histogram_bucket{{{lab},le="+Inf"}} {cum}'
+            )
+            lines.append(f"{prefix}_histogram_sum{{{lab}}} {_fmt(total)}")
+            lines.append(f"{prefix}_histogram_count{{{lab}}} {cum}")
     tstages = summary.get("trace_stages")
     if tstages:
         # Per-stage series overall ({stage="decode"}) AND per replica
@@ -344,6 +380,90 @@ def to_prometheus(summary: dict, prefix: str = "chainermn_tpu") -> str:
     return "\n".join(lines) + "\n"
 
 
+# ---------------------------------------------------------------------------
+# Metric regression gate (``obs diff``)
+# ---------------------------------------------------------------------------
+# Direction heuristics on flattened key paths: which way is "worse".
+# Checked in order — a higher-is-better match wins over lower-is-better
+# so e.g. "tokens_per_sec" is not misread by its "_s" suffix.
+_HIGHER_BETTER = (
+    "per_sec", "per_second", "tokens_per_sec", "goodput", "throughput",
+    "accuracy", "hit_rate", "accept_len", "capacity", "finished",
+    "free_blocks", "improvement", "speedup",
+)
+_LOWER_BETTER = (
+    "p99", "p95", "p50", "latency", "seconds", "_s", "_ms", "err",
+    "loss", "shed", "rejected", "preempt", "violation", "burn",
+    "compile", "dur", "orphan", "restarts", "dropped",
+)
+
+
+def _direction(path: str):
+    low = path.lower()
+    if any(t in low for t in _HIGHER_BETTER):
+        return "higher_better"
+    if any(t in low for t in _LOWER_BETTER):
+        return "lower_better"
+    return None
+
+
+def _flatten(obj, prefix="") -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.update(_flatten(v, f"{prefix}{k}."))
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            out.update(_flatten(v, f"{prefix}{i}."))
+    elif isinstance(obj, bool):
+        pass  # booleans are not metrics
+    elif isinstance(obj, (int, float)):
+        out[prefix[:-1]] = float(obj)
+    return out
+
+
+def metric_diff(a: dict, b: dict, threshold: float = 0.05) -> dict:
+    """Compare two JSON metric reports (bench output, ``summarize``
+    output, Reporter summaries).  Numeric leaves are flattened to dotted
+    paths; a leaf whose path matches a direction heuristic and moved the
+    wrong way by more than ``threshold`` (relative) is a regression.
+    Directionless leaves are reported as ``changed`` but never gate."""
+    fa, fb = _flatten(a), _flatten(b)
+    regressions, improvements, changed = [], [], []
+    for path in sorted(fa.keys() & fb.keys()):
+        va, vb = fa[path], fb[path]
+        if va == vb:
+            continue
+        rel = (vb - va) / abs(va) if va != 0 else math.inf
+        row = {"key": path, "a": va, "b": vb,
+               "rel_change": None if math.isinf(rel) else rel}
+        direction = _direction(path)
+        if direction is None:
+            changed.append(row)
+            continue
+        worse = rel > threshold if direction == "lower_better" \
+            else rel < -threshold
+        better = rel < -threshold if direction == "lower_better" \
+            else rel > threshold
+        row["direction"] = direction
+        if worse:
+            regressions.append(row)
+        elif better:
+            improvements.append(row)
+        else:
+            changed.append(row)
+    return {
+        "threshold": threshold,
+        "compared": len(fa.keys() & fb.keys()),
+        "only_a": sorted(fa.keys() - fb.keys()),
+        "only_b": sorted(fb.keys() - fa.keys()),
+        "regressions": regressions,
+        "improvements": improvements,
+        "changed": changed,
+        "ok": not regressions,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m chainermn_tpu.tools.obs",
@@ -382,7 +502,34 @@ def main(argv=None) -> int:
                         "multiple of the fleet median")
     t.add_argument("--no-rotated", action="store_true")
 
+    d = sub.add_parser(
+        "diff",
+        help="regression gate between two JSON metric reports "
+             "(e.g. BENCH_*.json pairs): exit 1 on regressions past "
+             "--threshold",
+    )
+    d.add_argument("a", help="baseline JSON report")
+    d.add_argument("b", help="candidate JSON report")
+    d.add_argument("--threshold", type=float, default=0.05,
+                   help="relative change gating a directional metric "
+                        "(default 0.05 = 5%%)")
+    d.add_argument("-o", "--output", default=None,
+                   help="write the diff JSON here (default: stdout)")
+
     args = ap.parse_args(argv)
+    if args.cmd == "diff":
+        with open(args.a) as f:
+            rep_a = json.load(f)
+        with open(args.b) as f:
+            rep_b = json.load(f)
+        result = metric_diff(rep_a, rep_b, threshold=args.threshold)
+        text = json.dumps(result, indent=2) + "\n"
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(text)
+        else:
+            sys.stdout.write(text)
+        return 0 if result["ok"] else 1
     rows = _load(args.logs, include_rotated=not args.no_rotated)
     if args.cmd == "summarize":
         print(json.dumps(summarize(rows, curve_points=args.curve_points)))

@@ -292,6 +292,7 @@ def run_local_traffic(args) -> int:
             ),
             reporter=reporter,
         )
+    exporter = _start_exporter(args, router.fleet_view)
     chaos = None
     if args.chaos:
         chaos = TimedChaos(ChaosSchedule.parse(args.chaos))
@@ -327,6 +328,7 @@ def run_local_traffic(args) -> int:
             lambda a: router.submit(
                 list(a.prompt), a.max_new_tokens,
                 timeout_s=args.timeout_s, priority=a.priority,
+                tenant=a.tenant,
             ),
             pump=pump, drain_timeout_s=args.timeout_s,
         )
@@ -377,6 +379,9 @@ def run_local_traffic(args) -> int:
     if tr is not None:
         _export_trace(args, tr, extra)
     tr_done()
+    if exporter is not None:
+        extra["metrics_url"] = exporter.url
+        exporter.stop()
     print(json.dumps(_report(args, results, wall, extra)))
     if args.verify and extra["parity"] != "ok":
         return 1
@@ -384,6 +389,7 @@ def run_local_traffic(args) -> int:
 
 
 def run_local(args) -> int:
+    from chainermn_tpu.observability.reporter import Reporter
     from chainermn_tpu.serving.cluster import (
         HeartbeatMonitor,
         Replica,
@@ -394,9 +400,13 @@ def run_local(args) -> int:
     tr, tr_done = _install_tracer(args)
     factory = _engine_factory(args)
     roles = _parse_roles(args.roles, args.replicas)
+    # A metrics endpoint needs a registry to serve: one shared Reporter
+    # across replicas + router (in-process, so the shared registry IS
+    # the fleet view).
+    reporter = Reporter() if args.metrics_port is not None else None
     replicas = [
         Replica(
-            i, factory(), role=roles[i],
+            i, factory(), role=roles[i], reporter=reporter,
             watermark_blocks=args.watermark, max_queue=args.max_queue,
             spec_tokens=args.spec_tokens,
         )
@@ -405,10 +415,12 @@ def run_local(args) -> int:
     router = ReplicaRouter(
         replicas,
         prefill_threshold=args.prefill_threshold,
+        reporter=reporter,
         health=HeartbeatMonitor(
             [r.replica_id for r in replicas], miss_after_s=30.0
         ),
     )
+    exporter = _start_exporter(args, router.fleet_view)
     prompts = _synthetic_prompts(args)
 
     t0 = time.perf_counter()
@@ -445,12 +457,28 @@ def run_local(args) -> int:
     if tr is not None:
         _export_trace(args, tr, extra)
     tr_done()
+    if exporter is not None:
+        extra["metrics_url"] = exporter.url
+        exporter.stop()
     print(json.dumps(_report(args, results, wall, extra)))
     if args.verify and extra["parity"] != "ok":
         return 1
     if any(r["status"] != "finished" for r in results.values()):
         return 1
     return 0
+
+
+def _start_exporter(args, source):
+    """Start a /metrics scrape endpoint over ``source`` when
+    --metrics-port asks for one.  Returns the running exporter or
+    None."""
+    if args.metrics_port is None:
+        return None
+    from chainermn_tpu.observability import MetricsExporter
+
+    exporter = MetricsExporter(source, port=args.metrics_port)
+    exporter.start()
+    return exporter
 
 
 def _init_distributed(args) -> None:
@@ -490,6 +518,7 @@ def run_multiprocess(args) -> int:
             role=role, max_queue=args.max_queue,
             watermark_blocks=args.watermark,
             flight_path=_flight_path(args),
+            metrics_port=args.metrics_port,
         )
         print(json.dumps({"mode": "replica", "rank": args.process_id,
                           **out}))
@@ -510,6 +539,8 @@ def run_multiprocess(args) -> int:
         prefill_threshold=args.prefill_threshold,
         timeout_s=args.timeout_s,
         flight_path=_flight_path(args),
+        metrics_port=args.metrics_port,
+        metrics_port_file=args.metrics_port_file,
     )
     wall = time.perf_counter() - t0
     extra = {}
@@ -631,6 +662,16 @@ def main(argv=None) -> int:
     ap.add_argument("--flight-dir", default=None,
                     help="directory for crash-surviving flight-recorder "
                          "logs (one JSONL per process; enables tracing)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve a Prometheus /metrics scrape endpoint "
+                         "on this port (0 = ephemeral).  Local roles "
+                         "export the fleet view; --role router the "
+                         "heartbeat-merged fleet view; --role replica "
+                         "its own registry")
+    ap.add_argument("--metrics-port-file", default=None,
+                    help="write the bound metrics port to this file "
+                         "(--role router; implies an ephemeral port "
+                         "when --metrics-port is unset)")
     # traffic
     ap.add_argument("--requests", type=int, default=8)
     ap.add_argument("--prompt-len", type=int, default=12,

@@ -52,6 +52,18 @@ the document is STILL MID-PREFILL and must route to the warm replica —
 which the router only knows is warm through the gossiped partial
 prefix.  Rank 0 prints ``SERVE_LONGCTX_OK holder=<rank>`` before
 ``SERVE_SOAK_OK``.
+
+With the argument ``metrics:<dir>`` the default kill9 soak additionally
+exercises the fleet observability plane over the wire: every request
+carries a tenant id, the router serves its merged fleet view at a live
+``/metrics`` endpoint (port written to ``<dir>/router_metrics_port``),
+and a rank-0 background thread scrapes it throughout the run.  After
+the streams verify, rank 0 asserts the scrape series: the SIGKILLed
+replica's per-replica series were present while it lived and are GONE
+from the final view, fleet counters stayed monotone on either side of
+the one step-down where the dead snapshot left the merge, and the
+per-tenant token counters survived the failover.  Prints
+``SERVE_METRICS_OK scrapes=<n>`` before ``SERVE_SOAK_OK``.
 """
 
 import os
@@ -62,6 +74,10 @@ def main():
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     kill_after = int(sys.argv[4])
     flight_dir = sys.argv[5] if len(sys.argv) > 5 else None
+    metrics_dir = None
+    if flight_dir and flight_dir.startswith("metrics:"):
+        metrics_dir = flight_dir.split(":", 1)[1]
+        flight_dir = None
     traffic = flight_dir == "traffic"
     gossip = flight_dir == "gossip"
     longctx = flight_dir == "longctx"
@@ -187,6 +203,41 @@ def main():
                 r["after_gids"] = list(range(6))
         if longctx:
             requests[1]["after_index_pages"] = 6
+        metrics_port_file = None
+        scrapes = []
+        scraper = None
+        stop_scraping = None
+        if metrics_dir is not None:
+            import threading
+            import time
+            import urllib.request
+
+            for gid, r in enumerate(requests):
+                r["tenant"] = f"t{gid % 2}"
+            metrics_port_file = os.path.join(metrics_dir,
+                                             "router_metrics_port")
+            stop_scraping = threading.Event()
+
+            def _scrape_loop():
+                while not stop_scraping.is_set():
+                    if os.path.exists(metrics_port_file):
+                        break
+                    time.sleep(0.05)
+                else:
+                    return
+                with open(metrics_port_file) as f:
+                    mport = int(f.read().strip())
+                url = f"http://127.0.0.1:{mport}/metrics"
+                while not stop_scraping.is_set():
+                    try:
+                        with urllib.request.urlopen(url, timeout=5) as rs:
+                            scrapes.append(rs.read().decode())
+                    except OSError:
+                        pass
+                    time.sleep(0.1)
+
+            scraper = threading.Thread(target=_scrape_loop, daemon=True)
+            scraper.start()
         reporter = slo = None
         if traffic:
             from chainermn_tpu.observability.reporter import Reporter
@@ -203,7 +254,11 @@ def main():
         results = service.run_router(
             nproc, requests, miss_after_s=30.0, timeout_s=180.0,
             flight_path=flight_path, reporter=reporter, slo=slo,
+            metrics_port_file=metrics_port_file,
         )
+        if scraper is not None:
+            stop_scraping.set()
+            scraper.join(timeout=10)
         try:
             oracle = engine_factory()
             failovers = 0
@@ -246,6 +301,38 @@ def main():
                 burn_max = max(burns.values())
                 assert burn_max < 1.0, f"SLO burned red: {burns}"
                 print(f"SERVE_TRAFFIC_OK burn_max={burn_max:.4f}")
+            if metrics_dir is not None:
+                import re
+
+                assert len(scrapes) >= 3, f"only {len(scrapes)} scrapes"
+                dead = f'replica="{nproc - 1}"'
+                lived = [i for i, s in enumerate(scrapes) if dead in s]
+                assert lived, "dead replica's series never scraped alive"
+                assert dead not in scrapes[-1], \
+                    "dead replica's series survived its forget"
+                # Per-tenant token accounting survived the failover: the
+                # orphaned requests re-bill on the adopting survivor.
+                ctr_re = re.compile(
+                    r'chainermn_tpu_counter_total\{name="([^"]+)"\} (\S+)')
+                final = {m.group(1): float(m.group(2))
+                         for m in ctr_re.finditer(scrapes[-1])}
+                for t in ("t0", "t1"):
+                    for which in ("tokens_in", "tokens_out"):
+                        name = f"tenant/{t}/{which}"
+                        assert final.get(name, 0.0) > 0, (name, final)
+                # Fleet counters are monotone except for the ONE step
+                # where the dead replica's snapshot leaves the merge —
+                # split there and each segment must be nondecreasing.
+                cut = lived[-1] + 1
+                for seg in (scrapes[:cut], scrapes[cut:]):
+                    prev = {}
+                    for s in seg:
+                        cur = {m.group(1): float(m.group(2))
+                               for m in ctr_re.finditer(s)}
+                        for k, v in prev.items():
+                            assert cur.get(k, 0.0) >= v, (k, v, cur.get(k))
+                        prev = cur
+                print(f"SERVE_METRICS_OK scrapes={len(scrapes)}")
         except BaseException:
             import traceback
 

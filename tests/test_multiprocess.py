@@ -385,6 +385,33 @@ def test_serving_traffic_soak_kill_at_peak_load():
     assert "SERVE_REPLICA_OK 1" in outs[1], outs[1]
 
 
+def test_serving_fleet_metrics_scrape_survives_kill9(tmp_path):
+    """The fleet observability soak: the kill9 topology (router + 2
+    replicas, highest rank SIGKILLed mid-stream) with every request
+    carrying a tenant id and the router serving its merged fleet view
+    at a live ``/metrics`` endpoint that a rank-0 thread scrapes
+    throughout.  On top of the bit-exact failover, the scrape series
+    must show: the dead replica's per-replica series present while it
+    lived and GONE from the final view (health.forget drops them within
+    one beat), fleet counters monotone on either side of the single
+    step-down where the dead snapshot left the merge, and per-tenant
+    token counters that survived the failover re-billing."""
+    import re
+
+    procs, outs = _launch(_SERVE_WORKER, 3, "12", f"metrics:{tmp_path}",
+                          n_devices=1, timeout=420)
+    codes = [p.returncode for p in procs]
+    assert codes[2] == -9, f"rank 2 should die by SIGKILL: {codes}\n" \
+        + "\n".join(outs)
+    assert codes[0] == 0, f"router failed:\n{outs[0]}"
+    assert "SERVE_SOAK_OK" in outs[0], outs[0]
+    m = re.search(r"SERVE_METRICS_OK scrapes=(\d+)", outs[0])
+    assert m, outs[0]
+    assert int(m.group(1)) >= 3
+    assert codes[1] == 0, f"survivor replica failed:\n{outs[1]}"
+    assert "SERVE_REPLICA_OK 1" in outs[1], outs[1]
+
+
 def test_serving_cluster_gossip_prefix_routing_kill9():
     """The cluster-global prefix index soak: router + 3 replicas running
     model-based speculative decode with chunked prefill.  Wave 1 seeds

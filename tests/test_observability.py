@@ -19,6 +19,8 @@ import numpy as np
 import pytest
 
 from chainermn_tpu.observability import (
+    AnomalyDetector,
+    MetricsExporter,
     Reporter,
     StepRecorder,
     audit_allreduce,
@@ -33,7 +35,7 @@ from chainermn_tpu.observability import (
     telemetry_active,
 )
 from chainermn_tpu.observability.reporter import _bucket
-from chainermn_tpu.tools.obs import summarize, to_prometheus
+from chainermn_tpu.tools.obs import metric_diff, summarize, to_prometheus
 
 
 # ---------------------------------------------------------------------------
@@ -532,3 +534,241 @@ def test_evaluator_reports_through_reporter(devices8, tmp_path):
     assert s["scalars"]["span/evaluate"]["count"] == 1
     rows = [x for x in read_records(rec.path) if x["event"] == "eval"]
     assert rows and rows[0]["metrics"]["val/m"] == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# Fleet-live plane: scrape endpoint, native histograms, stale-series
+# hygiene, anomaly detection, and the ``obs diff`` regression gate
+# ---------------------------------------------------------------------------
+
+
+def _scrape(url):
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        return resp.read().decode()
+
+
+def test_native_histogram_exposition_cumulative():
+    """Pow2 histograms render as a real Prometheus histogram: cumulative
+    ``le`` buckets at exact 2^b upper bounds, +Inf, _sum, _count."""
+    r = Reporter()
+    for v in (0.75, 3.0, 3.5, 0.0):  # buckets 0, 2, 2, -30
+        r.histogram_observe("trace/decode", v)
+    text = to_prometheus(r.summary())
+    assert "# TYPE chainermn_tpu_histogram histogram" in text
+    rows = [ln for ln in text.splitlines()
+            if ln.startswith("chainermn_tpu_histogram")]
+    import re
+
+    cums = [int(m.group(1)) for m in (
+        re.search(r"} (\d+)$", ln) for ln in rows if "_bucket" in ln
+    )]
+    assert cums == [1, 2, 4, 4]  # le=2^-30, le=1, le=4, le=+Inf
+    bounds = re.findall(r'le="([^"]+)"', "\n".join(rows))
+    assert bounds[1:] == ["1", "4", "+Inf"]
+    assert float(bounds[0]) == pytest.approx(2.0 ** -30)
+    (sum_row,) = [ln for ln in rows if "_sum" in ln]
+    assert float(sum_row.rsplit(" ", 1)[1]) == pytest.approx(9.0, rel=1e-6)
+    (count_row,) = [ln for ln in rows if "_count" in ln]
+    assert count_row.endswith(" 4")
+
+
+def test_native_histogram_replica_label_split():
+    r = Reporter()
+    r.histogram_observe("trace/decode/replica/3", 2.0)
+    text = to_prometheus(r.summary())
+    assert 'name="trace/decode",replica="3"' in text
+    assert "trace/decode/replica/3" not in text
+
+
+def test_metrics_exporter_scrape_counters_move():
+    """Two scrapes of a live endpoint observe the counter move — the
+    pull-model smoke test."""
+    r = Reporter()
+    r.count("serving/steps", 3)
+    exp = MetricsExporter(r, port=0)
+    port = exp.start()
+    try:
+        assert exp.url == f"http://127.0.0.1:{port}/metrics"
+        assert exp.start() == port  # idempotent
+        t1 = _scrape(exp.url)
+        assert 'chainermn_tpu_counter_total{name="serving/steps"} 3' in t1
+        r.count("serving/steps", 2)
+        r.gauge("serving/queue_depth", 4)
+        t2 = _scrape(exp.url)
+        assert 'chainermn_tpu_counter_total{name="serving/steps"} 5' in t2
+        assert 'chainermn_tpu_gauge{name="serving/queue_depth"} 4' in t2
+    finally:
+        exp.stop()
+    exp.stop()  # idempotent after shutdown
+
+
+def test_metrics_exporter_callable_source_and_404():
+    """A zero-arg callable works as the source (the router's fleet-view
+    hook); non-metrics paths 404; bad sources are rejected."""
+    import urllib.error
+    import urllib.request
+
+    calls = []
+
+    def source():
+        calls.append(1)
+        return {"counters": {"fleet/scrapes": len(calls)}}
+
+    with MetricsExporter(source, port=0) as exp:
+        assert 'name="fleet/scrapes"} 1' in _scrape(exp.url)
+        assert 'name="fleet/scrapes"} 2' in _scrape(exp.url)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                exp.url.replace("/metrics", "/nope"), timeout=10
+            )
+        assert ei.value.code == 404
+    with pytest.raises(TypeError):
+        MetricsExporter(42)
+
+
+def test_forget_replica_drops_only_that_replicas_series():
+    """The stale-series fix: a dead replica's series leave every table,
+    without touching a replica whose id merely shares a prefix."""
+    r = Reporter()
+    r.gauge("serving/running/replica/2", 3)
+    r.gauge("serving/running/replica/12", 1)
+    r.count("serving/steps", 7)
+    r.count("trace/stage/replica/2/decode", 1)  # id as a path segment
+    r.histogram_observe("trace/decode/replica/2", 1.0)
+    r.observe("lat/replica/2", 0.5)
+    assert r.forget_replica(2) == 4
+    s = r.summary()
+    assert "serving/running/replica/2" not in s["gauges"]
+    assert s["gauges"]["serving/running/replica/12"]["value"] == 1
+    assert s["counters"] == {"serving/steps": 7}
+    assert s["histograms"] == {}
+    assert "lat/replica/2" not in s["scalars"]
+    assert r.forget_replica(2) == 0
+
+
+def _fleet_summary(tokens, hist=None):
+    return {
+        "counters": {"serving/tokens": tokens},
+        "histograms": {
+            "trace/decode": {str(b): c for b, c in (hist or {}).items()}
+        },
+    }
+
+
+def test_anomaly_latency_regression_edge_counted_once():
+    """Median of NEW observations rising past regression_factor x the
+    baseline median alarms; the counter records the onset once while
+    the gauge tracks the level."""
+    rep = Reporter()
+    det = AnomalyDetector(reporter=rep, window=2, baseline=8,
+                          min_samples=2, regression_factor=2.0)
+    hist = {0: 0}
+    for i in range(6):  # healthy: one new bucket-0 obs (median 1.0)
+        hist[0] += 1
+        st = det.update(_fleet_summary(0, hist), now=float(i))
+        assert not st["latency_regression"]
+    assert not det.alarming()
+    hist[3] = 0
+    for i in range(6, 8):  # regression: new obs in bucket 3 (8x)
+        hist[3] += 1
+        st = det.update(_fleet_summary(0, hist), now=float(i))
+    assert st["latency_regression"] and det.alarming()
+    assert st["latency_ratio"] == pytest.approx(8.0)
+    s = rep.summary()
+    assert s["counters"]["anomaly/latency_regression"] == 1
+    assert s["gauges"]["anomaly/latency_regression"]["value"] == 1.0
+    # still alarming next tick: level stays, onset is not re-counted
+    hist[3] += 1
+    det.update(_fleet_summary(0, hist), now=8.0)
+    assert rep.summary()["counters"]["anomaly/latency_regression"] == 1
+    # recovery clears the gauge
+    for i in range(9, 15):
+        hist[0] += 1
+        det.update(_fleet_summary(0, hist), now=float(i))
+    assert not det.alarming()
+    assert rep.summary()["gauges"][
+        "anomaly/latency_regression"]["value"] == 0.0
+
+
+def test_anomaly_goodput_drop_and_membership_step_down():
+    """Token rate falling below drop_factor x baseline alarms; a merged
+    counter stepping DOWN (a replica leaving the fleet view) reads as
+    zero rate, never negative."""
+    det = AnomalyDetector(window=2, baseline=8, min_samples=2,
+                          drop_factor=0.5)
+    tokens = 0.0
+    st = None
+    for i in range(6):  # 100 tokens/s baseline
+        tokens += 100.0
+        st = det.update(_fleet_summary(tokens), now=float(i))
+        assert not st["goodput_drop"]
+    for i in range(6, 8):  # collapse to 10 tokens/s
+        tokens += 10.0
+        st = det.update(_fleet_summary(tokens), now=float(i))
+    assert st["goodput_drop"] and det.alarming()
+    assert st["goodput_ratio"] == pytest.approx(0.1)
+    # fleet-membership step-down: no crash, clamped to zero rate
+    st = det.update(_fleet_summary(tokens - 500.0), now=9.0)
+    assert st["goodput_ratio"] is not None and st["goodput_ratio"] >= 0.0
+
+
+def test_anomaly_source_callable_and_no_source_error():
+    det = AnomalyDetector()
+    with pytest.raises(ValueError):
+        det.update()
+    fleet = {"n": 0.0}
+
+    def source():
+        fleet["n"] += 50.0
+        return _fleet_summary(fleet["n"])
+
+    det2 = AnomalyDetector(source=source, window=2, baseline=8,
+                           min_samples=2)
+    for i in range(4):
+        det2.update(now=float(i))
+    assert not det2.alarming()
+
+
+def test_metric_diff_directional_gate():
+    a = {"latency_p99_s": 1.0, "tokens_per_sec": 100.0, "widgets": 3.0}
+    b = {"latency_p99_s": 1.5, "tokens_per_sec": 100.0, "widgets": 4.0}
+    d = metric_diff(a, b, threshold=0.05)
+    assert not d["ok"]
+    assert [r["key"] for r in d["regressions"]] == ["latency_p99_s"]
+    # directionless leaves report as changed but never gate
+    assert [r["key"] for r in d["changed"]] == ["widgets"]
+    # the same movement in reverse is an improvement, not a regression
+    d2 = metric_diff(b, a, threshold=0.05)
+    assert d2["ok"]
+    assert [r["key"] for r in d2["improvements"]] == ["latency_p99_s"]
+    # throughput drops gate too (higher-is-better heuristic)
+    d3 = metric_diff({"goodput_tps": 100.0}, {"goodput_tps": 80.0})
+    assert not d3["ok"]
+
+
+def test_obs_diff_cli_exit_codes(tmp_path):
+    """The regression gate: nonzero exit + JSON report on a seeded
+    regression, zero on self-compare."""
+    from chainermn_tpu.tools import obs
+
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(
+        {"serving": {"latency_p99_s": 1.0, "goodput_tps": 50.0}}
+    ))
+    b.write_text(json.dumps(
+        {"serving": {"latency_p99_s": 2.0, "goodput_tps": 50.0}}
+    ))
+    out = tmp_path / "diff.json"
+    rc = obs.main(["diff", str(a), str(b), "--threshold", "0.1",
+                   "-o", str(out)])
+    assert rc == 1
+    rep = json.loads(out.read_text())
+    assert not rep["ok"]
+    assert rep["regressions"][0]["key"] == "serving.latency_p99_s"
+    assert obs.main(["diff", str(a), str(a), "-o", str(out)]) == 0
+    assert json.loads(out.read_text())["ok"]

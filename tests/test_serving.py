@@ -1351,3 +1351,104 @@ if __name__ == "__main__":
     if not ap.parse_args().regen:
         ap.error("run under pytest, or pass --regen to regenerate")
     _regen()
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant KV page-seconds: exact residency integrals
+# ---------------------------------------------------------------------------
+
+
+def test_kv_page_seconds_conservation():
+    """With an injectable clock, per-tenant residency integrals are
+    exact, shared prefix pages bill their FIRST owner only, untenanted
+    holdings stay in the pool integral, and the sum of all owner
+    buckets equals the pool integral through alloc / extend / share /
+    truncate / free / defragment."""
+    t = [0.0]
+    kv = PagedKVCache(n_blocks=16, block_size=4, clock=lambda: t[0])
+
+    kv.allocate("a", 8, tenant="ta")        # 2 pages, ta
+    t[0] = 5.0                              # ta: 2pg x 5s = 10
+    kv.allocate("b", 4, tenant="tb")        # 1 page, tb
+    t[0] = 7.0                              # ta +4, tb +2
+    kv.extend("a", 12)                      # ta now holds 3 pages
+    t[0] = 10.0                             # ta +9, tb +3
+    ps = kv.page_seconds()
+    assert ps == {"ta": pytest.approx(23.0), "tb": pytest.approx(5.0)}
+    assert kv.pool_page_seconds() == pytest.approx(28.0)
+
+    # tb shares ta's registered prefix: the 3 shared pages keep
+    # accruing to ta (first owner), only tb's fresh page bills tb
+    toks = list(range(12))
+    kv.register_prefix("a", toks)
+    shared = kv.match_prefix(toks)
+    assert len(shared) == 3
+    kv.allocate("c", 14, prefix_pages=shared, tenant="tb")
+    t[0] = 12.0                             # ta +6, tb +2+2
+    ps = kv.page_seconds()
+    assert ps == {"ta": pytest.approx(29.0), "tb": pytest.approx(9.0)}
+    assert kv.pool_page_seconds() == pytest.approx(sum(ps.values()))
+    kv.assert_consistent()
+
+    # untenanted holdings: excluded from the tenant map, in the pool
+    kv.allocate("d", 4)                     # 1 page, owner None
+    t[0] = 14.0                             # ta +6, tb +4, None +2
+    ps = kv.page_seconds()
+    assert set(ps) == {"ta", "tb"}
+    assert kv.pool_page_seconds() == pytest.approx(sum(ps.values()) + 2.0)
+
+    # freeing the first owner does NOT re-bill still-shared pages: a's
+    # pages stay held under ta while c references them
+    kv.free("a")
+    kv.free("d")
+    t[0] = 16.0                             # ta +6, tb +4
+    ps = kv.page_seconds()
+    assert ps == {"ta": pytest.approx(41.0), "tb": pytest.approx(17.0)}
+    assert kv.pool_page_seconds() == pytest.approx(sum(ps.values()) + 2.0)
+
+    # owners survive page renumbering
+    kv.defragment()
+    kv.assert_consistent()
+    t[0] = 18.0                             # ta +6, tb +4
+    kv.truncate("c", 12)                    # releases tb's fresh page
+    t[0] = 20.0                             # ta +6, tb +2 (b only)
+    ps = kv.page_seconds()
+    assert ps == {"ta": pytest.approx(53.0), "tb": pytest.approx(23.0)}
+
+    # all sequences gone: the meter stops (cached refcount-0 prefix
+    # pages are reclaimable capacity, not tenant residency)
+    kv.free("b")
+    kv.free("c")
+    t[0] = 100.0
+    assert kv.page_seconds() == {"ta": pytest.approx(53.0),
+                                 "tb": pytest.approx(23.0)}
+    assert kv.pool_page_seconds() == pytest.approx(53.0 + 23.0 + 2.0)
+    kv.assert_consistent()
+
+
+def test_kv_page_seconds_scheduler_attribution(lm, lm_params):
+    """Request.tenant flows scheduler -> kv.allocate: the scheduler's
+    end-of-step gauges publish per-tenant page-seconds that sum to the
+    pool integral when every request is tenanted."""
+    from chainermn_tpu.observability.reporter import Reporter
+
+    reporter = Reporter()
+    engine = make_engine(lm, lm_params)
+    sched = ContinuousBatchingScheduler(engine, reporter=reporter)
+    sched.add_request(Request(request_id=0, prompt=[1, 2, 3, 4, 5],
+                              max_new_tokens=4, tenant="ta"))
+    sched.add_request(Request(request_id=1, prompt=[6, 7, 8],
+                              max_new_tokens=4, tenant="tb"))
+    sched.run_to_completion()
+    ps = engine.kv.page_seconds()
+    assert set(ps) == {"ta", "tb"}
+    assert sum(ps.values()) == pytest.approx(
+        engine.kv.pool_page_seconds())
+    g = reporter.summary()["gauges"]
+    assert g["tenant/ta/kv_page_seconds"]["value"] == pytest.approx(
+        ps["ta"])
+    # tokens emitted under each tenant were counted as they streamed
+    c = reporter.summary()["counters"]
+    assert c["tenant/ta/tokens_out"] == 4
+    assert c["tenant/tb/tokens_out"] == 4
+    engine.kv.assert_consistent()

@@ -806,3 +806,147 @@ def test_serving_cluster_soak_threaded_failover(lm, lm_params):
     for r in reps:
         if r.replica_id != victim:
             r.engine.kv.assert_consistent()
+
+
+# ---------------------------------------------------------------------------
+# Fleet metrics plane: beat-carried snapshots, idempotent merge,
+# dead-replica series hygiene, per-tenant accounting through the view
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_gossip_idempotent_under_dup_and_reorder():
+    """Replaying the beat stream in any order, with duplicates, folds to
+    the same fleet view — the strictly-newer version check makes the
+    merge idempotent exactly like the prefix index."""
+    import random
+
+    from chainermn_tpu.observability.reporter import Reporter
+    from chainermn_tpu.serving.cluster import MetricsGossip
+
+    def snap(steps, tokens):
+        r = Reporter()
+        r.count("serving/steps", steps)
+        r.count("serving/tokens", tokens)
+        r.gauge(f"serving/running/replica/{steps}", steps)
+        return r.summary()
+
+    beats = [(1, 1, snap(1, 10)), (1, 2, snap(2, 25)),
+             (2, 1, snap(3, 7)), (2, 2, snap(5, 9))]
+    g = MetricsGossip()
+    for rid, v, s in beats:
+        assert g.observe(rid, v, s)
+    want = g.fleet_view()
+    assert want["counters"]["serving/steps"] == 2 + 5
+    assert want["counters"]["serving/tokens"] == 25 + 9
+
+    rng = random.Random(7)
+    for _ in range(5):
+        replay = beats * 3
+        rng.shuffle(replay)
+        g2 = MetricsGossip()
+        for rid, v, s in replay:
+            g2.observe(rid, v, s)
+        assert g2.fleet_view() == want
+        assert g2.version(1) == 2 and g2.version(2) == 2
+
+    # wire compat: None summaries and stale versions are no-ops
+    assert not g.observe(1, 5, None)
+    assert not g.observe(1, 1, snap(99, 99))
+    assert g.fleet_view() == want
+    # forget drops the replica's whole contribution from the next view
+    g.forget(2)
+    assert g.replicas() == [1]
+    assert g.fleet_view()["counters"]["serving/steps"] == 2
+    assert g.latest(2) is None and g.version(2) is None
+
+
+def test_fleet_view_tenants_and_dead_replica_series_drop(lm, lm_params):
+    """End-to-end fleet plane, in process: each replica owns a registry
+    gossiped on its load beats, the router's fleet_view merges them with
+    its own reporter (per-tenant counters included), and failing a
+    replica drops its per-replica series from the very next view."""
+    from chainermn_tpu.observability.reporter import Reporter
+
+    router_rep = Reporter()
+    mreps = {i: Reporter() for i in range(2)}
+    reps = [
+        Replica(i, make_engine(lm, lm_params), role="both",
+                reporter=mreps[i], metrics_reporter=mreps[i],
+                max_queue=8)
+        for i in range(2)
+    ]
+    router = ReplicaRouter(
+        reps, reporter=router_rep,
+        health=HeartbeatMonitor([0, 1], miss_after_s=1e9),
+    )
+    prompts = prompts_for(4, rng_seed=19)
+    handles = [router.submit(p, 6, tenant=f"t{i % 2}")
+               for i, p in enumerate(prompts)]
+    router.run_until_idle()
+    assert all(h.status == "finished" for h in handles)
+
+    view = router.fleet_view()
+    # one scrape covers the fleet: per-tenant token accounting is exact
+    produced = sum(len(h.tokens) for h in handles)
+    assert (view["counters"]["tenant/t0/tokens_out"]
+            + view["counters"]["tenant/t1/tokens_out"]) == produced
+    assert (view["counters"]["tenant/t0/tokens_in"]
+            + view["counters"]["tenant/t1/tokens_in"]
+            ) == sum(len(p) for p in prompts)
+    assert view["counters"]["tenant/t0/admit"] == 2
+    # per-tenant KV residency gauges rode the beats in
+    assert view["gauges"]["tenant/t0/kv_page_seconds"]["value"] > 0
+    # per-replica series from BOTH replicas are visible in the one view
+    for rid in (0, 1):
+        assert any(k.endswith(f"/replica/{rid}") for k in view["gauges"])
+
+    # kill replica 0: snapshot AND router-side per-replica series drop
+    # from the very next fleet_view — no beat needed, no stale series
+    router.fail_replica(0, "test kill")
+    view2 = router.fleet_view()
+    for table in ("gauges", "counters", "histograms"):
+        stale = [k for k in view2.get(table, {})
+                 if k.endswith("/replica/0") or "/replica/0/" in k]
+        assert not stale, (table, stale)
+    assert 0 not in router.metrics.replicas()
+    # the survivor's series are untouched
+    assert any(k.endswith("/replica/1") for k in view2["gauges"])
+    reps[1].engine.kv.assert_consistent()
+
+
+def test_retire_replica_forgets_metrics_snapshot(lm, lm_params):
+    """Planned scale-down hygiene matches the failure path: retiring a
+    drained replica removes its gossiped snapshot and per-replica
+    series from the fleet view."""
+    from chainermn_tpu.observability.reporter import Reporter
+
+    router_rep = Reporter()
+    mreps = {i: Reporter() for i in range(2)}
+    reps = [
+        Replica(i, make_engine(lm, lm_params), role="both",
+                reporter=mreps[i], metrics_reporter=mreps[i],
+                max_queue=8)
+        for i in range(2)
+    ]
+    router = ReplicaRouter(reps, reporter=router_rep)
+    # enough concurrent work that BOTH replicas serve some of it, so
+    # the survivor's snapshot carries tenant counters after the retire
+    handles = [router.submit(p, 4, tenant="acme")
+               for p in prompts_for(6, rng_seed=23)]
+    router.run_until_idle()
+    assert all(h.status == "finished" for h in handles)
+    assert {h.replica_id for h in handles} == {0, 1}
+    assert 1 in router.metrics.replicas()
+    router.drain(1)
+    router.migrate_out(1)
+    router.run_until_idle()
+    assert router.retire_replica(1)
+    assert 1 not in router.metrics.replicas()
+    view = router.fleet_view()
+    assert not any(
+        k.endswith("/replica/1") or "/replica/1/" in k
+        for table in ("gauges", "counters", "histograms")
+        for k in view.get(table, {})
+    )
+    # tenant counters from the SURVIVOR keep accumulating in the view
+    assert view["counters"]["tenant/acme/tokens_out"] > 0
