@@ -645,6 +645,8 @@ def bench_serve(comm, args):
         if kd is not None:
             out["kv_dtype"] = _serve_kv_ab(args, model, params, prompts,
                                            best, kd)
+    if args.serve_tp:
+        out["tp"] = _serve_tp_bench(args, model, params, prompts, best)
     if args.serve_replicas > 1:
         out["cluster"] = bench_serve_cluster(args, model, params)
     if args.serve_traffic:
@@ -656,10 +658,13 @@ def bench_serve(comm, args):
 
 def _serve_sweep_point(args, model, params, prompts, bs, *,
                        spec_tokens, prefix_cache=True, kv_dtype=None,
-                       draft=None, draft_layers=None):
+                       draft=None, draft_layers=None, tp=1):
     """One measured serving run: fresh engine at decode batch ``bs``,
     all ``prompts`` through the queue frontend, tokens/sec plus
     per-token latency percentiles and the prefix/speculation counters.
+    With ``tp`` > 1 the engine's params and KV pages are committed
+    through the registry ``tp`` plan over that many local devices, so
+    the jitted data plane runs GSPMD tensor-parallel.
     """
     from chainermn_tpu.serving import (
         ContinuousBatchingScheduler,
@@ -681,7 +686,13 @@ def _serve_sweep_point(args, model, params, prompts, bs, *,
         draft=draft,
         draft_layers=draft_layers,
     )
-    engine = InferenceEngine(model, params, ecfg)
+    plan = mesh = None
+    if tp > 1:
+        from jax.sharding import Mesh
+
+        plan = "tp"
+        mesh = Mesh(np.asarray(jax.devices()[:tp]), ("model",))
+    engine = InferenceEngine(model, params, ecfg, plan=plan, mesh=mesh)
     sched = ContinuousBatchingScheduler(engine, spec_tokens=spec_tokens)
     fe = ServeFrontend(sched, max_queue=args.serve_queue)
 
@@ -756,7 +767,43 @@ def _serve_sweep_point(args, model, params, prompts, bs, *,
     if "kv_quant_err" in st:
         row["kv_dtype"] = st["kv_dtype"]
         row["kv_quant_err"] = st["kv_quant_err"]
+    if tp > 1:
+        row["group_size"] = tp
     return row
+
+
+def _serve_tp_bench(args, model, params, prompts, best):
+    """``--serve-tp``: decode tokens/sec versus tensor-parallel group
+    size at the winning batch size — the scaling curve behind the
+    shard-group design (``docs/serving.md``).  Each point reruns the
+    identical greedy traffic with the engine's params and KV pages
+    committed through the registry ``tp`` plan over K local devices
+    (K=1 is the unsharded baseline).  Sizes that don't divide the
+    model's heads/FFN or exceed the local device count are skipped and
+    reported, never silently dropped."""
+    sizes = [int(k) for k in args.serve_tp_sizes.split(",")]
+    n_dev = len(jax.devices())
+    curve, skipped = [], []
+    for k in sizes:
+        if (k > n_dev or args.lm_heads % k
+                or args.lm_d_ff % k or args.lm_d_model % k):
+            skipped.append({"group_size": k, "reason": (
+                "exceeds local device count" if k > n_dev
+                else "does not divide model geometry")})
+            continue
+        row = _serve_sweep_point(args, model, params, prompts,
+                                 best["batch_size"], spec_tokens=0,
+                                 tp=k)
+        row["group_size"] = k
+        curve.append(row)
+    base = next((r for r in curve if r["group_size"] == 1), None)
+    if base is not None:
+        for r in curve:
+            r["speedup"] = round(
+                r["tokens_per_sec"]
+                / max(base["tokens_per_sec"], 1e-9), 3)
+    return {"devices": n_dev, "batch_size": best["batch_size"],
+            "curve": curve, "skipped": skipped}
 
 
 def _serve_draft_ab(args, model, params, prompts, best):
@@ -1676,6 +1723,14 @@ def main(argv=None):
                          "tier (threaded replicas behind the router) "
                          "and the prefill/decode disaggregation p99 "
                          "proof at this replica count")
+    ap.add_argument("--serve-tp", action="store_true",
+                    help="with --serve: also sweep decode tokens/sec "
+                         "versus tensor-parallel group size (the "
+                         "registry 'tp' plan over local devices) at "
+                         "the winning batch size — the shard-group "
+                         "scaling curve")
+    ap.add_argument("--serve-tp-sizes", default="1,2,4",
+                    help="comma-separated group sizes for --serve-tp")
     ap.add_argument("--serve-queue", type=int, default=None,
                     help="bounded frontend queue size per "
                          "replica/engine (default: fits all requests)")

@@ -476,6 +476,61 @@ def fixture_sharded_prefill() -> dict:
     )
 
 
+def fixture_tp_decode(n_layers: int = 1) -> dict:
+    """The tensor-parallel decode step a shard group's leader jits: the
+    ordinary paged decode program with params and KV pages committed
+    through the registry ``tp`` plan over a 2-device ``("model",)``
+    mesh, so GSPMD partitions attention and FFN by heads/columns.  A
+    CLEAN fixture (``expect=None``) at the jaxpr level — the partitioner
+    inserts the per-layer output-projection all-reduces AFTER tracing,
+    which is exactly why the pinned TP census
+    (``tests/golden/serving_tp_decode_census.json``) audits the COMPILED
+    HLO instead.  ``n_layers`` is a parameter so that census can diff a
+    2-layer against a 1-layer program and pin the per-layer collective
+    count, not just the total."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+
+    from chainermn_tpu.models.transformer import TransformerLM
+    from chainermn_tpu.sharding.registry import get_plan
+
+    geom = dict(vocab=32, d_model=16, n_heads=2, d_ff=32,
+                n_layers=n_layers, max_len=16, page_count=8, page_size=4)
+    model = TransformerLM(**geom, paged="decode")
+    B, W = 2, 4
+    tokens = jnp.zeros((B,), jnp.int32)
+    tables = jnp.zeros((B, W), jnp.int32)
+    lens = jnp.zeros((B,), jnp.int32)
+    variables = model.init(
+        jax.random.PRNGKey(0), tokens[:, None],
+        position_offset=lens[:, None], block_tables=tables,
+        seq_lens=lens,
+    )
+    params, cache = variables["params"], variables["cache"]
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("model",))
+    plan = get_plan("tp")
+    params = jax.device_put(params, plan.shardings(mesh, params))
+    cache = jax.device_put(cache, plan.shardings(mesh, cache))
+    rep = NamedSharding(mesh, P())
+    tokens, tables, lens = (
+        jax.device_put(x, rep) for x in (tokens, tables, lens)
+    )
+
+    def decode_step(params, cache, tokens, tables, lens):
+        logits, upd = model.apply(
+            {"params": params, "cache": cache}, tokens[:, None],
+            position_offset=lens[:, None], block_tables=tables,
+            seq_lens=lens, mutable=["cache"],
+        )
+        return logits[:, 0].astype(jnp.float32), upd["cache"]
+
+    return dict(
+        target="tp_decode", expect=None,
+        fn=jax.jit(decode_step, donate_argnums=(1,)),
+        args=(params, cache, tokens, tables, lens), kwargs={}, comm=None,
+    )
+
+
 FIXTURES: Dict[str, Callable[[], dict]] = {
     "r001": fixture_r001,
     "r002": fixture_r002,
@@ -489,6 +544,7 @@ FIXTURES: Dict[str, Callable[[], dict]] = {
     "serving_decode": fixture_serving_decode,
     "serving_verify": fixture_serving_verify,
     "sharded_prefill": fixture_sharded_prefill,
+    "tp_decode": fixture_tp_decode,
     "draft_verify": fixture_draft_verify,
 }
 

@@ -669,3 +669,58 @@ def pipeline_forward_and_loss(
     out = spmd_pipeline(stage_fn, stage_params, x, axis_name, n_microbatches)
     local = jnp.where(idx == n - 1, loss_fn(out, target), 0.0)
     return lax.psum(local, axis_name)
+
+
+# ---------------------------------------------------------------------
+# serving-side composition: decode microbatching for tp×pp shard groups
+# ---------------------------------------------------------------------
+
+def decode_microbatches(n_rows: int, n_stages: int):
+    """Contiguous split of a decode batch's row range ``[0, n_rows)``
+    into at most ``n_stages`` microbatches — the serving analogue of
+    this module's microbatch axis.  Returns ``[(start, stop), ...]`` in
+    dispatch order (GPipe fill order: stage 0's rows first), sized as
+    evenly as possible with the remainder on the leading stages, so the
+    split is a pure function of ``(n_rows, n_stages)`` and two shard
+    groups given the same batch dispatch identical steps.
+
+    Splitting is bit-exact for the serving stack by construction:
+    paged attention is per-sequence and sampling counter-based, so a
+    row's logits (and its sampled token) never depend on which other
+    rows share its step.
+    """
+    n_rows = int(n_rows)
+    n_stages = max(1, int(n_stages))
+    if n_rows <= 0:
+        return []
+    k = min(n_rows, n_stages)
+    base, rem = divmod(n_rows, k)
+    spans = []
+    start = 0
+    for s in range(k):
+        stop = start + base + (1 if s < rem else 0)
+        spans.append((start, stop))
+        start = stop
+    return spans
+
+
+def serve_pipeline_order(n_micro: int, n_stages: int):
+    """Dispatch order of ``(stage, microbatch)`` ticks for a serving
+    decode iteration pipelined over ``n_stages`` stage subgroups — the
+    same fill-drain wavefront :func:`spmd_pipeline` executes, viewed
+    from the host dispatcher: microbatch ``m`` enters stage ``s`` at
+    tick ``m + s``, so total latency is ``n_micro + n_stages - 1``
+    stage-times against ``n_micro * n_stages`` sequential (the GPipe
+    bubble).  Used by the bench's tp×pp model and pinned by unit test;
+    the leader's own dispatch loop only needs the microbatch order
+    (:func:`decode_microbatches`) because follower stages replay
+    asynchronously."""
+    n_micro = max(0, int(n_micro))
+    n_stages = max(1, int(n_stages))
+    order = []
+    for tick in range(n_micro + n_stages - 1):
+        for s in range(n_stages):
+            m = tick - s
+            if 0 <= m < n_micro:
+                order.append((tick, s, m))
+    return order

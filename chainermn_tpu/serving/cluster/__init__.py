@@ -30,7 +30,11 @@ this package turns N of them into a routed fleet:
   migrate → retire on idleness, emergency backfill on death;
 * :mod:`driver` — threaded per-replica stepping for benchmarks;
 * :mod:`service` — router/replica event loops over the ObjectPlane for
-  real multi-process deployments (``python -m chainermn_tpu.tools.serve``).
+  real multi-process deployments (``python -m chainermn_tpu.tools.serve``);
+* :mod:`shard_group` — a replica as a multi-process tensor-parallel
+  shard group (leader + lockstep follower shards, group id = leader
+  rank, any-shard death fails the whole group), with tp×pp decode
+  microbatching composed from :mod:`chainermn_tpu.parallel.pipeline`.
 """
 
 from chainermn_tpu.serving.cluster.autoscaler import (  # noqa: F401
@@ -69,4 +73,10 @@ from chainermn_tpu.serving.cluster.replica import (  # noqa: F401
 from chainermn_tpu.serving.cluster.router import (  # noqa: F401
     ClusterHandle,
     ReplicaRouter,
+)
+from chainermn_tpu.serving.cluster.shard_group import (  # noqa: F401
+    GroupLeader,
+    GroupSpec,
+    plan_groups,
+    run_follower,
 )

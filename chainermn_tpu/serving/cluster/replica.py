@@ -88,6 +88,12 @@ class ReplicaLoad:
     #: time, or None when the replica runs without a reporter / the
     #: beat came from an old peer (wire compat).
     metrics: Optional[dict] = None
+    #: shard-group geometry: TP shards per pipeline stage and stage
+    #: count (serving/cluster/shard_group.py).  1×1 = a one-process
+    #: replica, and what beats from peers predating shard groups
+    #: report (wire compat: trailing defaulted fields).
+    group_size: int = 1
+    pp_stages: int = 1
 
     @property
     def free_frac(self) -> float:
@@ -147,6 +153,12 @@ class Replica:
         )
         self.alive = True
         self.draining = False
+        #: shard-group geometry this replica fronts (the leader sets
+        #: these when the replica spans a multi-process group); they
+        #: ride every load beat so routers and fleet views see group
+        #: shape without extra wire traffic.
+        self.group_size = 1
+        self.pp_stages = 1
         self.lock = threading.Lock()
         self._prefill_jobs: Deque[PrefillJob] = deque()
         #: completed prefills awaiting router placement.
@@ -210,6 +222,8 @@ class Replica:
             max_bucket=self.engine.max_bucket,
             metrics_version=metrics_version,
             metrics=metrics,
+            group_size=self.group_size,
+            pp_stages=self.pp_stages,
         )
 
     def metrics_beat(self) -> Tuple[int, Optional[dict]]:

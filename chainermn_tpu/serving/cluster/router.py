@@ -82,6 +82,9 @@ class ClusterHandle:
     #: accounting identity — travels with every placement so tenant
     #: counters survive migration/failover.
     tenant: Optional[str] = None
+    #: opt-in to the SHARED prefix-cache namespace (common system
+    #: prompts); default is the tenant's salted namespace.
+    shared_prefix: bool = False
     #: times this stream moved replicas via live KV-page migration
     #: (scale-down drains; distinct from failover replays).
     migrations: int = 0
@@ -106,6 +109,10 @@ class ClusterHandle:
         if self.timeout_s is None:
             return None
         return self.timeout_s - (now - self.submitted_at)
+
+    @property
+    def prefix_namespace(self) -> Optional[str]:
+        return None if self.shared_prefix else self.tenant
 
 
 class ReplicaRouter:
@@ -191,6 +198,7 @@ class ReplicaRouter:
                             timeout_s: Optional[float] = None,
                             now: Optional[float] = None,
                             prompt_tokens: Optional[List[int]] = None,
+                            namespace: Optional[str] = None,
                             ) -> Optional[Replica]:
         """The best admissible decode-capable replica for a prompt of
         ``prompt_len`` tokens, or None when nothing admits it.
@@ -214,10 +222,14 @@ class ReplicaRouter:
             load = rep.load(now)
             hit_pages = 0
             if prompt_tokens:
-                hit_pages = len(rep.engine.kv.match_prefix(prompt_tokens))
+                hit_pages = len(rep.engine.kv.match_prefix(
+                    prompt_tokens, namespace=namespace
+                ))
                 bs = rep.engine.kv.block_size
                 if bs not in digests_by_bs:
-                    digests_by_bs[bs] = prompt_digests(prompt_tokens, bs)
+                    digests_by_bs[bs] = prompt_digests(
+                        prompt_tokens, bs, namespace=namespace
+                    )
                 hit_pages = max(hit_pages, self.gossip.hit_pages(
                     digests_by_bs[bs], rep.replica_id
                 ))
@@ -286,13 +298,16 @@ class ReplicaRouter:
                on_token: Optional[Callable[[int, int], None]] = None,
                priority: int = 0,
                tenant: Optional[str] = None,
+               shared_prefix: bool = False,
                ) -> ClusterHandle:
         """Route one request; raises :class:`QueueFull` (with the
         minimum retry-after hint across replicas) when no replica
         admits it.  ``priority`` is the shed class (0 = most
         important): when every queue is full, an arrival may displace
         a strictly lower-class waiting request instead of being
-        rejected (see ``ServeFrontend.submit``)."""
+        rejected (see ``ServeFrontend.submit``).  ``shared_prefix``
+        opts a tenanted request into the shared prefix-cache
+        namespace (see the frontend's docstring)."""
         gid = self._next_gid
         self._next_gid += 1
         handle = ClusterHandle(
@@ -306,6 +321,7 @@ class ReplicaRouter:
             on_token=on_token,
             priority=int(priority),
             tenant=tenant,
+            shared_prefix=bool(shared_prefix),
         )
         self._handles[gid] = handle
         tr = _tracing.get_tracer()
@@ -377,6 +393,7 @@ class ReplicaRouter:
             len(handle.prompt) + len(committed),
             timeout_s=handle._remaining_timeout(now), now=now,
             prompt_tokens=handle.prompt,
+            namespace=handle.prefix_namespace,
         )
         if rep is None:
             rep = self._pick_shed_target(handle.priority)
@@ -407,6 +424,7 @@ class ReplicaRouter:
                 trace=root,
                 priority=handle.priority,
                 tenant=handle.tenant,
+                shared_prefix=handle.shared_prefix,
             )
         if tr is not None and root is not None:
             tr.record_span("placement", root, t0, tr.clock() - t0,
@@ -466,6 +484,7 @@ class ReplicaRouter:
             len(handle.prompt) + len(handle.tokens),
             timeout_s=handle._remaining_timeout(now), now=now,
             prompt_tokens=handle.prompt,
+            namespace=handle.prefix_namespace,
         )
         if rep is None:
             return False
@@ -477,6 +496,8 @@ class ReplicaRouter:
             stop_token=handle.stop_token,
             on_token=lambda _rid, tok: handle._commit(tok),
             trace=root,
+            tenant=handle.tenant,
+            shared_prefix=handle.shared_prefix,
         )
         req.generated = list(handle.tokens)
         with rep.lock:
@@ -799,6 +820,7 @@ class ReplicaRouter:
                     trace=handle._trace_root,
                     priority=handle.priority,
                     tenant=handle.tenant,
+                    shared_prefix=handle.shared_prefix,
                 )
         except QueueFull as e:
             handle.status = "failed"
@@ -854,6 +876,7 @@ class ReplicaRouter:
                     trace=handle._trace_root,
                     priority=handle.priority,
                     tenant=handle.tenant,
+                    shared_prefix=handle.shared_prefix,
                 )
                 req2.generated = list(req.generated)
                 target.frontend.adopt(

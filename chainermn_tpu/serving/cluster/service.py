@@ -42,6 +42,14 @@ a replica's edge — or ``miss_after_s`` without an event batch — as that
 replica's death, and re-places its live requests on survivors with
 their committed token prefix (bit-exact resume, same as the in-process
 router).  Replicas symmetrically exit if the router's edge dies.
+
+Shard groups (serving/cluster/shard_group.py): with ``group_size`` /
+``pp_stages`` > 1 the replica ranks partition into consecutive groups
+— one leader (it alone runs this module's replica loop and owns all
+CMD/EVT/SNAP traffic; group id = leader rank) plus followers running
+the lockstep replay loop over the intra-group channel (tag GRP=3 on
+the same plane).  The router addresses leaders only; any shard's death
+collapses the whole group onto the existing failover path.
 """
 
 from __future__ import annotations
@@ -88,13 +96,44 @@ def run_replica(rank: int, size: int, engine_factory,
                 plane: Optional[ObjectPlane] = None,
                 flight_path: Optional[str] = None,
                 spec_tokens: int = 0,
-                metrics_port: Optional[int] = None) -> dict:
+                metrics_port: Optional[int] = None,
+                group=None,
+                kill_after_ops: Optional[int] = None) -> dict:
     """Serve as replica ``rank`` until the router says stop (or the
     router's edge dies).  ``engine_factory()`` builds the
     InferenceEngine (model + params + config) — construction is the
     caller's business, the loop is ours.  ``kill_after_tokens`` is the
     soak-test hook: SIGKILL THIS process after streaming that many
     tokens (mid-stream, no cleanup — simulating a crashed host).
+
+    ``group`` — a :class:`~chainermn_tpu.serving.cluster.shard_group.
+    GroupSpec` when this rank is part of a multi-process shard group.
+    The leader rank runs the normal replica loop with the group's
+    mirror fan-out attached; any OTHER rank of the group dispatches
+    straight to the follower replay loop (no router edge at all).
+    ``kill_after_ops`` is the follower-side soak hook: SIGKILL a
+    follower after replaying that many mirrored steps."""
+    if group is not None and rank != group.leader:
+        from chainermn_tpu.serving.cluster.shard_group import (
+            run_follower,
+        )
+
+        return run_follower(
+            rank, group, engine_factory, plane or _mk_plane(rank, size),
+            kill_after_ops=kill_after_ops,
+        )
+    return _run_replica_outer(
+        rank, size, engine_factory, role, max_queue, watermark_blocks,
+        heartbeat_s, kill_after_tokens, plane, flight_path, spec_tokens,
+        metrics_port, group,
+    )
+
+
+def _run_replica_outer(rank, size, engine_factory, role, max_queue,
+                       watermark_blocks, heartbeat_s,
+                       kill_after_tokens, plane, flight_path,
+                       spec_tokens, metrics_port, group) -> dict:
+    """Tracer/exporter scaffolding around the leader's serve loop.
 
     ``flight_path`` — install a tracer backed by a crash-surviving
     :class:`FlightRecorder` at that path for the duration (no-op when a
@@ -120,7 +159,7 @@ def run_replica(rank: int, size: int, engine_factory,
         return _run_replica_inner(
             rank, size, engine_factory, role, max_queue,
             watermark_blocks, heartbeat_s, kill_after_tokens, plane,
-            spec_tokens, reporter,
+            spec_tokens, reporter, group,
         )
     finally:
         if exporter is not None:
@@ -133,7 +172,7 @@ def run_replica(rank: int, size: int, engine_factory,
 def _run_replica_inner(rank, size, engine_factory, role, max_queue,
                        watermark_blocks, heartbeat_s,
                        kill_after_tokens, plane, spec_tokens=0,
-                       reporter=None) -> dict:
+                       reporter=None, group=None) -> dict:
     import os
     import signal
 
@@ -145,6 +184,11 @@ def _run_replica_inner(rank, size, engine_factory, role, max_queue,
         plane.send([], 0, tag=EVT)
     except PeerGone:
         return {"streamed": 0, "reason": "router gone"}
+    leader = None
+    if group is not None and group.followers:
+        from chainermn_tpu.serving.cluster.shard_group import GroupLeader
+
+        leader = GroupLeader(plane, group)
     rep = Replica(
         rank, engine_factory(), role=role,
         watermark_blocks=watermark_blocks, max_queue=max_queue,
@@ -153,6 +197,13 @@ def _run_replica_inner(rank, size, engine_factory, role, max_queue,
         # and gossips it to the router on every load beat.
         reporter=reporter, metrics_reporter=reporter,
     )
+    if leader is not None:
+        # Every device-mutating engine step now fans out to the
+        # follower shards before running locally; a dead follower
+        # surfaces as PeerGone from the step itself or from poll().
+        leader.attach(rep.engine)
+        rep.group_size = group.group_size
+        rep.pp_stages = group.pp_stages
     outbox: List[tuple] = []
     gid_of_local: Dict[int, int] = {}
     snapshots: Dict[int, object] = {}  # gid -> finished PrefillResult
@@ -185,8 +236,9 @@ def _run_replica_inner(rank, size, engine_factory, role, max_queue,
                     committed=msg["committed"],
                     trace=ctx,
                     # .get(): wire compat with routers predating the
-                    # tenant accounting field.
+                    # tenant accounting / prefix-isolation fields.
                     tenant=msg.get("tenant"),
+                    shared_prefix=bool(msg.get("shared_prefix", False)),
                 )
             except QueueFull as e:
                 outbox.append(("reject", gid, e.retry_after_s))
@@ -247,6 +299,8 @@ def _run_replica_inner(rank, size, engine_factory, role, max_queue,
                     stop_token=msg["stop_token"],
                     on_token=on_token_for(gid),
                     trace=ctx,
+                    tenant=msg.get("tenant"),
+                    shared_prefix=bool(msg.get("shared_prefix", False)),
                 )
                 req.generated = list(msg["committed"])
                 rep.frontend.adopt(req, timeout_s=msg["timeout_s"])
@@ -279,7 +333,17 @@ def _run_replica_inner(rank, size, engine_factory, role, max_queue,
             if not handle_cmd(msg):
                 running = False
                 break
-        rep.step()
+        try:
+            rep.step()
+            if leader is not None:
+                leader.poll()
+        except PeerGone:
+            # A follower shard died: the mirror fan-out (inside
+            # rep.step()) or the beat poll hit its dead edge.  Any-shard
+            # death fails the WHOLE group — exit the serve loop so the
+            # router sees PeerGone on this leader's edges within one
+            # beat and re-places every live stream on a survivor group.
+            return {"streamed": streamed, "reason": "follower gone"}
         # Finished prefills: announce, park the snapshot for migration.
         while rep.handoffs:
             res = rep.handoffs.popleft()
@@ -317,6 +381,8 @@ def _run_replica_inner(rank, size, engine_factory, role, max_queue,
             last_evt = now
         if not rep.has_work:
             time.sleep(0.002)
+    if leader is not None:
+        leader.stop()
     try:
         plane.send([("load", rep.load().as_dict())], 0, tag=EVT)
     except PeerGone:
@@ -360,12 +426,22 @@ def run_router(size: int, requests: List[dict],
                flight_path: Optional[str] = None,
                slo=None,
                metrics_port: Optional[int] = None,
-               metrics_port_file: Optional[str] = None) -> Dict[int, dict]:
+               metrics_port_file: Optional[str] = None,
+               group_size: int = 1,
+               pp_stages: int = 1) -> Dict[int, dict]:
     """Drive ``requests`` (dicts: prompt, max_new_tokens, optional
-    sampling/stop_token/timeout_s) to completion over replicas at
-    subgroup ranks ``1..size-1``.  Returns ``{gid: {"tokens": [...],
-    "status": ..., "error": ..., "failovers": n}}`` with token streams
-    exactly as a single sequential engine would produce them.
+    sampling/stop_token/timeout_s/tenant/shared_prefix) to completion
+    over the replica processes at subgroup ranks ``1..size-1``.
+    Returns ``{gid: {"tokens": [...], "status": ..., "error": ...,
+    "failovers": n}}`` with token streams exactly as a single
+    sequential engine would produce them.
+
+    ``group_size`` / ``pp_stages`` — shard-group geometry: the replica
+    ranks partition into consecutive groups of ``group_size ×
+    pp_stages`` processes (shard_group.plan_groups) and the router
+    addresses ONLY the leaders; 1×1 is the historical one-process
+    fleet.  The launcher must start the follower ranks with the
+    matching ``group=`` spec on :func:`run_replica`.
 
     ``flight_path`` — install a FlightRecorder-backed tracer for the
     duration; the router owns every request's ROOT span (it survives
@@ -417,7 +493,7 @@ def run_router(size: int, requests: List[dict],
     try:
         return _run_router_inner(
             size, requests, prefill_threshold, roles, miss_after_s,
-            timeout_s, reporter, plane, metrics,
+            timeout_s, reporter, plane, metrics, group_size, pp_stages,
         )
     finally:
         if exporter is not None:
@@ -429,10 +505,20 @@ def run_router(size: int, requests: List[dict],
 
 def _run_router_inner(size, requests, prefill_threshold, roles,
                       miss_after_s, timeout_s, reporter,
-                      plane, metrics=None) -> Dict[int, dict]:
+                      plane, metrics=None, group_size=1,
+                      pp_stages=1) -> Dict[int, dict]:
+    from chainermn_tpu.serving.cluster.shard_group import plan_groups
+
     plane = plane or _mk_plane(0, size)
     tr = _tracing.get_tracer()
-    replica_ranks = list(range(1, size))
+    # Shard groups: only leaders carry CMD/EVT/SNAP edges.  Follower
+    # ranks are invisible here — their death surfaces as the LEADER's
+    # edge dying (the leader exits on intra-group PeerGone), so every
+    # liveness / failover / gossip structure below keys on leader ranks
+    # and needs no group awareness.
+    replica_ranks = [
+        g.leader for g in plan_groups(size, group_size, pp_stages)
+    ]
     alive = set(replica_ranks)
     # Role map is declared up-front (the launcher knows what it started)
     # and refined by load reports as replicas phone home.
@@ -474,6 +560,10 @@ def _run_router_inner(size, requests, prefill_threshold, roles,
         spec.setdefault("after_index_pages", None)
         # Accounting identity (per-tenant counters + SLO burn).
         spec.setdefault("tenant", None)
+        # Prefix-cache isolation: page digests are salted with the
+        # tenant namespace unless the request opts into the shared
+        # namespace (common system prompts).  See kv_cache.prefix_digest.
+        spec.setdefault("shared_prefix", False)
         rr = _RemoteRequest(gid, spec)
         if tr is not None:
             root_attrs = dict(rid=gid, prompt_len=len(spec["prompt"]),
@@ -522,7 +612,14 @@ def _run_router_inner(size, requests, prefill_threshold, roles,
                 if prompt and not rr.tokens and ld.block_size > 0:
                     bs = ld.block_size
                     if bs not in digests_by_bs:
-                        digests_by_bs[bs] = prompt_digests(prompt, bs)
+                        # Salted with the request's namespace, so a
+                        # tenant only scores affinity against pages it
+                        # may actually reuse.
+                        digests_by_bs[bs] = prompt_digests(
+                            prompt, bs,
+                            namespace=(None if rr.spec["shared_prefix"]
+                                       else rr.spec["tenant"]),
+                        )
                     hit = gossip.hit_pages(digests_by_bs[bs], r)
                     prefix_frac = min(
                         1.0, hit * bs / max(1, len(prompt))
@@ -558,6 +655,7 @@ def _run_router_inner(size, requests, prefill_threshold, roles,
             "committed": list(rr.tokens),
             "trace": wire_trace(rr),
             "tenant": rr.spec["tenant"],
+            "shared_prefix": rr.spec["shared_prefix"],
         })
         if ok:
             if tr is not None and rr.trace is not None:
@@ -669,6 +767,8 @@ def _run_router_inner(size, requests, prefill_threshold, roles,
                         "timeout_s": rr.spec["timeout_s"],
                         "committed": list(rr.tokens),
                         "trace": wire_trace(rr),
+                        "tenant": rr.spec["tenant"],
+                        "shared_prefix": rr.spec["shared_prefix"],
                     })
             elif kind == "adopted":
                 _, gid = ev
@@ -732,7 +832,13 @@ def _run_router_inner(size, requests, prefill_threshold, roles,
                         continue
                     bs = ld.block_size
                     if bs not in digs:
-                        digs[bs] = prompt_digests(prompt, bs)
+                        digs[bs] = prompt_digests(
+                            prompt, bs,
+                            namespace=(
+                                None if rr.spec["shared_prefix"]
+                                else rr.spec["tenant"]
+                            ),
+                        )
                     if gossip.hit_pages(digs[bs], r) >= pages_gate:
                         warm = True
                         break

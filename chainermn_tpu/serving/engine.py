@@ -459,6 +459,24 @@ class InferenceEngine:
                 donate_argnums=(1,),
             )
 
+        #: shard-group mirror hook: when set (the leader of a TP shard
+        #: group), every device-mutating step — prefill/decode/chunk/
+        #: CoW/defrag — first emits ``(op, host payload)`` here, and a
+        #: follower replays it with :meth:`apply_step`.  The payload is
+        #: exactly the host-side arrays the jit call consumes, so the
+        #: replayed program is the SAME compiled program: on CPU the
+        #: mirrored caches stay bit-identical, on a real TP mesh each
+        #: process runs its shard of the one GSPMD program in lockstep.
+        self.mirror_sink = None
+        #: decode microbatching for the tp×pp serving mode: when > 1,
+        #: each decode iteration splits its rows into this many
+        #: contiguous microbatches (``parallel/pipeline.py`` supplies
+        #: the fill order) and runs one step per microbatch — on a
+        #: shard group the stage subgroups overlap those steps.
+        #: Bit-exact by construction: attention is per-sequence and
+        #: sampling counter-based, so no stream's tokens depend on
+        #: batch composition.
+        self.pp_stages = 1
         self._prefill_shapes: set = set()
         self._decode_shapes: set = set()
         self._chunk_shapes: set = set()
@@ -541,6 +559,56 @@ class InferenceEngine:
         if getattr(self, "draft_model", None) is not None:
             self.draft_model.rebind(self.params)
 
+    # -- shard-group mirroring -----------------------------------------
+    def _mirror(self, op: str, *payload) -> None:
+        if self.mirror_sink is not None:
+            self.mirror_sink(op, payload)
+
+    def apply_step(self, op: str, payload) -> None:
+        """Replay one mirrored device step — the follower half of a TP
+        shard group.  ``(op, payload)`` is what the leader's
+        ``mirror_sink`` emitted; the follower drives the same jitted
+        program over its own params/cache (same seed-derived values,
+        same plan placement) and keeps only the cache update — logits
+        are discarded, sampling and all host accounting are
+        leader-only."""
+        if op == "prefill":
+            padded, table, lens = payload
+            out = self._prefill_jit(
+                self.params, self._cache, jnp.asarray(padded),
+                jnp.asarray(table), jnp.asarray(lens),
+            )
+            self._cache = out[1]
+        elif op == "decode":
+            tok, tables, lens = payload
+            out = self._decode_jit(
+                self.params, self._cache, jnp.asarray(tok),
+                jnp.asarray(tables), jnp.asarray(lens),
+            )
+            self._cache = out[1]
+        elif op == "chunk":
+            tok, tables, start, use_sp = payload
+            step = self._sp_chunk_jit if use_sp else self._chunk_jit
+            out = step(
+                self.params, self._cache, jnp.asarray(tok),
+                jnp.asarray(tables), jnp.asarray(start),
+            )
+            self._cache = out[1]
+        elif op == "cow":
+            old, new = payload
+            self._cache = self._cow_jit(
+                self._cache, jnp.asarray(old, jnp.int32),
+                jnp.asarray(new, jnp.int32),
+            )
+        elif op == "defrag":
+            (perm,) = payload
+            iperm = jnp.asarray(perm)
+            self._cache = jax.tree.map(
+                lambda leaf: jnp.take(leaf, iperm, axis=0), self._cache
+            )
+        else:
+            raise ValueError(f"unknown mirrored op {op!r}")
+
     # -- geometry ------------------------------------------------------
     @property
     def max_batch(self) -> int:
@@ -605,6 +673,7 @@ class InferenceEngine:
         padded[0, :L] = toks
         table = self.kv.padded_table(seq_id, W)[None]
         self._prefill_shapes.add((S, W))
+        self._mirror("prefill", padded, table, np.asarray([L], np.int32))
         out = self._prefill_jit(
             self.params, self._cache, jnp.asarray(padded),
             jnp.asarray(table), jnp.asarray([L], np.int32),
@@ -625,7 +694,25 @@ class InferenceEngine:
         batch is padded to its pow2 bucket with inert rows (invalid
         tables, ``seq_len = -1`` → the page write drops, the gather
         masks to nothing).
+
+        With ``pp_stages > 1`` the iteration splits into per-stage
+        microbatches dispatched as separate steps (same per-row
+        results — batch composition never changes a stream).
         """
+        B = len(tokens)
+        if self.pp_stages > 1 and B > 1:
+            from chainermn_tpu.parallel.pipeline import (
+                decode_microbatches,
+            )
+
+            return np.concatenate([
+                self._decode_step(tokens[a:b], seq_ids[a:b],
+                                  seq_lens[a:b])
+                for a, b in decode_microbatches(B, self.pp_stages)
+            ], axis=0)
+        return self._decode_step(tokens, seq_ids, seq_lens)
+
+    def _decode_step(self, tokens, seq_ids, seq_lens) -> np.ndarray:
         B = len(tokens)
         if B == 0:
             raise ValueError("empty decode batch")
@@ -646,6 +733,7 @@ class InferenceEngine:
         for i, sid in enumerate(seq_ids):
             tables[i] = self.kv.padded_table(sid, W)
         self._decode_shapes.add((Bp, W))
+        self._mirror("decode", tok, tables, lens)
         out = self._decode_jit(
             self.params, self._cache, jnp.asarray(tok),
             jnp.asarray(tables), jnp.asarray(lens),
@@ -707,6 +795,7 @@ class InferenceEngine:
         else:
             self._chunk_shapes.add((Bp, T, W))
             step = self._chunk_jit
+        self._mirror("chunk", tok, tables, start, use_sp)
         out = step(
             self.params, self._cache, jnp.asarray(tok),
             jnp.asarray(tables), jnp.asarray(start),
@@ -762,6 +851,7 @@ class InferenceEngine:
         if split is None:
             return False
         old, new = split
+        self._mirror("cow", int(old), int(new))
         self._cache = self._cow_jit(
             self._cache, jnp.asarray(old, jnp.int32),
             jnp.asarray(new, jnp.int32),
@@ -825,6 +915,7 @@ class InferenceEngine:
         perm = self.kv.defragment()
         if perm is None:
             return 0
+        self._mirror("defrag", np.asarray(perm))
         iperm = jnp.asarray(perm)
 
         def permute(leaf):

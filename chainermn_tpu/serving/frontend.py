@@ -230,6 +230,7 @@ class ServeFrontend:
                speculative: bool = True,
                priority: int = 0,
                tenant: Optional[str] = None,
+               shared_prefix: bool = False,
                ) -> RequestHandle:
         """Enqueue one request; raises :class:`QueueFull` (with a
         ``retry_after_s`` hint once throughput is known) when the
@@ -255,7 +256,12 @@ class ServeFrontend:
 
         ``tenant`` — accounting identity: admits/sheds/rejects and
         token flow are additionally counted under ``tenant/<id>/*``
-        (None = untenanted, no extra series)."""
+        (None = untenanted, no extra series).
+
+        ``shared_prefix`` — opt a tenanted request into the SHARED
+        prefix-cache namespace (for common system prompts); by default
+        tenanted requests match and register prefixes only within
+        their tenant's salted namespace."""
         priority = int(priority)
         if self.queue_depth() >= self.max_queue and not self._shed_one(
             priority, self.clock()
@@ -280,6 +286,7 @@ class ServeFrontend:
             speculative=speculative,
             priority=priority,
             tenant=tenant,
+            shared_prefix=bool(shared_prefix),
         )
         if self.reporter is not None:
             self.reporter.count(f"serve/admit/{priority}", 1)

@@ -53,30 +53,43 @@ import numpy as np
 from chainermn_tpu.ops.decode_attention import invalid_block
 
 
-def prefix_digest(token_ids: Sequence[int]) -> int:
+def prefix_digest(token_ids: Sequence[int],
+                  namespace: Optional[str] = None) -> int:
     """Content-addressed 64-bit digest of one prefix-index key (a
     cumulative full-page token prefix).  blake2b over the little-endian
     int64 token run, so two replicas computing the digest of the same
     prompt prefix agree regardless of platform — the identity the
     cluster-global prefix index gossips.  Defrag-stable for free: index
     KEYS are token runs; :meth:`PagedKVCache.defragment` rewrites only
-    the page ids behind them."""
+    the page ids behind them.
+
+    ``namespace`` salts the digest: a tenant-private prefix run hashes
+    under its tenant id, so one tenant's gossiped digests can never
+    collide with (and thus never confirm the existence of) another
+    tenant's prompts — the cross-tenant timing side-channel closes at
+    the identity layer, and the gossip plane carries salted digests
+    with no wire change.  ``None`` (the shared namespace) reproduces
+    the historical digest bit-for-bit."""
     data = np.asarray(list(token_ids), dtype="<i8").tobytes()
+    if namespace is not None:
+        data = str(namespace).encode("utf-8") + b"\x00" + data
     return int.from_bytes(
         hashlib.blake2b(data, digest_size=8).digest(), "big"
     )
 
 
-def prompt_digests(token_ids: Sequence[int], block_size: int) -> List[int]:
+def prompt_digests(token_ids: Sequence[int], block_size: int,
+                   namespace: Optional[str] = None) -> List[int]:
     """Digests of every full-page cumulative prefix of ``token_ids`` —
     what a router computes from a *prompt alone* to probe a remote
     replica's gossiped digest set (the remote analogue of
-    :meth:`PagedKVCache.match_prefix`)."""
+    :meth:`PagedKVCache.match_prefix`).  ``namespace`` salts each
+    digest exactly as :func:`prefix_digest` does."""
     toks = [int(t) for t in token_ids]
     bs = int(block_size)
     if bs <= 0:
         return []
-    return [prefix_digest(toks[: (i + 1) * bs])
+    return [prefix_digest(toks[: (i + 1) * bs], namespace)
             for i in range(len(toks) // bs)]
 
 
@@ -140,8 +153,10 @@ class PagedKVCache:
         # page holding its LAST block, plus the reverse map.  Registered
         # pages with refcount 0 park in the LRU ``_cached`` pool
         # (front = oldest = first evicted).
-        self._index: Dict[Tuple[int, ...], int] = {}
-        self._index_key_of: Dict[int, Tuple[int, ...]] = {}
+        self._index: Dict[Tuple[Optional[str], Tuple[int, ...]], int] = {}
+        self._index_key_of: Dict[
+            int, Tuple[Optional[str], Tuple[int, ...]]
+        ] = {}
         self._cached: "OrderedDict[int, None]" = OrderedDict()
         #: monotone prefix-index version — bumped on every index
         #: mutation, the anti-entropy stamp the gossip plane publishes
@@ -193,36 +208,48 @@ class PagedKVCache:
         return need <= avail - reserve
 
     # -- prefix index --------------------------------------------------
-    def match_prefix(self, token_ids) -> List[int]:
+    # Index keys are ``(namespace, token-run)`` pairs: ``namespace`` is
+    # the tenant isolation domain (None = the shared namespace every
+    # pre-tenant caller lives in) and the token run is the cumulative
+    # full-page prefix.  Two tenants prefilling the same document get
+    # DISTINCT keys — neither can observe (via admission latency or
+    # gossip digests) that the other's prompt is resident.
+    def match_prefix(self, token_ids,
+                     namespace: Optional[str] = None) -> List[int]:
         """The longest run of FULL pages from the index covering a
-        prefix of ``token_ids``.  Read-only (claiming happens in
-        :meth:`allocate`); routers use it to score placement without
-        perturbing the pool.  Returns page ids in table order."""
+        prefix of ``token_ids`` within ``namespace``.  Read-only
+        (claiming happens in :meth:`allocate`); routers use it to score
+        placement without perturbing the pool.  Returns page ids in
+        table order."""
         if not self.prefix_cache:
             return []
         toks = tuple(int(t) for t in token_ids)
         pages: List[int] = []
         for i in range(len(toks) // self.block_size):
-            page = self._index.get(toks[: (i + 1) * self.block_size])
+            page = self._index.get(
+                (namespace, toks[: (i + 1) * self.block_size])
+            )
             if page is None:
                 break
             pages.append(page)
         return pages
 
-    def register_prefix(self, seq_id, token_ids) -> int:
+    def register_prefix(self, seq_id, token_ids,
+                        namespace: Optional[str] = None) -> int:
         """Publish ``seq_id``'s pages covering the full-page prefix of
-        ``token_ids`` (its prompt) into the index, so later sequences
-        can share them.  Call only once the pages' K/V is actually
-        written (post-prefill).  Pages whose prefix is already indexed
-        (including pages shared *from* the index at admission) are left
-        alone.  Returns how many pages were newly registered."""
+        ``token_ids`` (its prompt) into the index under ``namespace``,
+        so later sequences in the same namespace can share them.  Call
+        only once the pages' K/V is actually written (post-prefill).
+        Pages whose prefix is already indexed (including pages shared
+        *from* the index at admission) are left alone.  Returns how
+        many pages were newly registered."""
         if not self.prefix_cache:
             return 0
         table = self._tables[seq_id]
         toks = tuple(int(t) for t in token_ids)
         new = 0
         for i in range(len(toks) // self.block_size):
-            key = toks[: (i + 1) * self.block_size]
+            key = (namespace, toks[: (i + 1) * self.block_size])
             page = table[i]
             if key in self._index or page in self._index_key_of:
                 continue
@@ -245,8 +272,10 @@ class PagedKVCache:
         index key, optionally capped at ``limit`` entries (wire-size
         bound for the gossip payload).  Matching is set-membership on
         the receiver, so order only matters under truncation — keys
-        iterate in registration order, oldest first."""
-        out = [prefix_digest(k) for k in self._index]
+        iterate in registration order, oldest first.  Tenant-salted
+        entries digest under their namespace (:func:`prefix_digest`),
+        so the published set leaks nothing across tenants."""
+        out = [prefix_digest(toks, ns) for ns, toks in self._index]
         if limit is not None:
             return out[: int(limit)]
         return out

@@ -74,6 +74,12 @@ class Request:
     #: accounting identity: token counters and KV page-seconds are
     #: attributed under ``tenant/<id>/*`` (None = untenanted).
     tenant: Optional[str] = None
+    #: prefix-cache sharing opt-in: a tenanted request normally matches
+    #: and registers prefixes only within its tenant's salted namespace
+    #: (isolation closes the cross-tenant timing side-channel); setting
+    #: this TRUE places the request in the shared (None) namespace —
+    #: for common system prompts every tenant is meant to share.
+    shared_prefix: bool = False
     #: host step index at which the first token appeared (TTFT proxy).
     first_token_step: Optional[int] = None
     #: trace context stage spans parent to (the request's ROOT — see
@@ -91,6 +97,12 @@ class Request:
     def context(self) -> List[int]:
         """Prompt + generated so far — what a re-prefill replays."""
         return list(self.prompt) + list(self.generated)
+
+    @property
+    def prefix_namespace(self) -> Optional[str]:
+        """The prefix-index namespace this request matches/registers
+        in: its tenant id, unless it opted into the shared one."""
+        return None if self.shared_prefix else self.tenant
 
     @property
     def done(self) -> bool:
@@ -236,7 +248,9 @@ class ContinuousBatchingScheduler:
             # Shared full pages covering the prompt's head are claimed
             # instead of allocated: a cache-hot prompt only pays for its
             # un-shared suffix (capacity-wise AND prefill-wise).
-            prefix = self.engine.kv.match_prefix(req.prompt)
+            prefix = self.engine.kv.match_prefix(
+                req.prompt, namespace=req.prefix_namespace
+            )
             # When nothing is running the watermark is waived — a lone
             # request that fits the bare pool must make progress.
             reserve = self.watermark if self.running else 0
@@ -360,7 +374,10 @@ class ContinuousBatchingScheduler:
                     logits = self.engine.prefill_cached(
                         req.context, req.request_id, hit
                     )
-                self.engine.kv.register_prefix(req.request_id, req.prompt)
+                self.engine.kv.register_prefix(
+                    req.request_id, req.prompt,
+                    namespace=req.prefix_namespace,
+                )
             except OutOfBlocks:
                 # The CoW split found no free page: un-admit; the next
                 # step retries (possibly after preemption frees pages).
@@ -404,7 +421,9 @@ class ContinuousBatchingScheduler:
                 # Re-probe the index before every slice: another
                 # sequence streaming the same document may have
                 # registered pages past this cursor since the last one.
-                hit = self.engine.kv.match_prefix(req.prompt)
+                hit = self.engine.kv.match_prefix(
+                    req.prompt, namespace=req.prefix_namespace
+                )
                 hit_tokens = len(hit) * bs
                 # Adopt only whole pages strictly below the final
                 # sampled position: the cursor stays page-aligned and
@@ -451,7 +470,8 @@ class ContinuousBatchingScheduler:
                     # or remote via the next gossip beat) shares them
                     # instead of re-prefilling.
                     self.engine.kv.register_prefix(
-                        req.request_id, req.prompt[:end]
+                        req.request_id, req.prompt[:end],
+                        namespace=req.prefix_namespace,
                     )
                 continue
             # Final slice: the prompt is fully written — register the
@@ -459,7 +479,10 @@ class ContinuousBatchingScheduler:
             # one-shot prefill would have (bit-exact by the chunk
             # contract: logits[0, t] predicts position pos + t + 1).
             req.prefill_pos = None
-            self.engine.kv.register_prefix(req.request_id, req.prompt)
+            self.engine.kv.register_prefix(
+                req.request_id, req.prompt,
+                namespace=req.prefix_namespace,
+            )
             tok = self.engine.sample(
                 logits[0, end - pos - 1], req.sampling, L
             )

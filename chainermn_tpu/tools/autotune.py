@@ -120,6 +120,15 @@ def main(argv=None) -> int:
                     help="also tune the chunked-prefill slice size "
                          "(0/off vs page-aligned slices) for the "
                          "--kv-page-size x --draft-max-len geometry")
+    # Shard-group shape (docs/serving.md "Shard groups").
+    ap.add_argument("--serve-group", action="store_true",
+                    help="also tune the serving shard-group shape "
+                         "(tensor-parallel group size x pipeline "
+                         "microbatch depth) for the --draft-* target "
+                         "family over the local devices")
+    ap.add_argument("--serve-group-batch", type=int, default=4,
+                    help="decode batch ceiling for the shard-group "
+                         "probe (bounds the pipeline depths tried)")
     # Long-context leg (docs/serving.md "Long-context serving").
     ap.add_argument("--prefill-chunk-long", action="store_true",
                     help="also rerun the slice-size objective at the "
@@ -138,6 +147,7 @@ def main(argv=None) -> int:
         tune_lm_shapes,
         tune_overlap_schedule,
         tune_prefill_chunk,
+        tune_serve_group,
     )
 
     log = None if args.quiet else (lambda m: print(m, file=sys.stderr))
@@ -217,6 +227,16 @@ def main(argv=None) -> int:
             repeats=args.repeats, log=log,
         )
         print(json.dumps({"prefill_chunk": rec}))
+    if args.serve_group:
+        rec = tune_serve_group(
+            vocab=args.draft_vocab, d_model=args.draft_d_model,
+            n_heads=args.heads, n_layers=args.draft_layers,
+            max_len=args.draft_max_len, block_size=args.kv_page_size,
+            batch=args.serve_group_batch, dtype=args.dtype,
+            cache=cache, force=args.force, dry_run=args.dry_run,
+            n1=args.n1, repeats=args.repeats, log=log,
+        )
+        print(json.dumps({"serve_group": rec}))
     if args.prefill_chunk_long:
         rec = tune_prefill_chunk(
             max_len=args.draft_max_len, block_size=args.kv_page_size,

@@ -46,6 +46,15 @@ Usage::
     python -m chainermn_tpu.tools.serve --role replica \
         --coordinator 127.0.0.1:9123 --num-processes 3 --process-id 2
 
+    # tensor-parallel shard groups, spawned locally: one router + one
+    # group of 2 shard processes; parity against the single-process
+    # oracle under BOTH greedy and sampled decoding
+    python -m chainermn_tpu.tools.serve --tp 2 --verify
+
+    # two tp=2 groups with pipelined decode microbatching (pp=2 per
+    # group -> 4 processes per group)
+    python -m chainermn_tpu.tools.serve --tp 2 --pp 2 --groups 2
+
 The model is the repo's own TransformerLM with randomly initialized
 parameters (geometry from the ``--vocab``/``--d-model``/... flags);
 every process derives identical params from ``--seed``, which is what
@@ -155,11 +164,34 @@ def _report(args, results: dict, wall: float, extra: dict) -> dict:
     return report
 
 
-def _oracle_streams(args, prompts) -> List[List[int]]:
+def _oracle_streams(args, prompts, samplings=None) -> List[List[int]]:
     """Sequential single-engine reference streams (one fresh engine so
-    cache state can't leak between the oracle and the cluster)."""
+    cache state can't leak between the oracle and the cluster).
+    ``samplings`` — optional per-prompt sampling dicts ({} = greedy),
+    so sampled-decode legs verify against the same counter-based RNG."""
+    from chainermn_tpu.serving import SamplingParams
+
     eng = _engine_factory(args)()
-    return [eng.generate(p, args.new_tokens) for p in prompts]
+    samplings = samplings or [{}] * len(prompts)
+    return [
+        eng.generate(p, args.new_tokens,
+                     sampling=SamplingParams(**s) if s else None)
+        for p, s in zip(prompts, samplings)
+    ]
+
+
+def _request_samplings(args, n: int) -> List[dict]:
+    """Per-request sampling policies: greedy everywhere, except
+    ``--sampled`` makes every odd request temperature/top-k sampled —
+    so one sweep exercises BOTH decode paths and ``--verify`` proves
+    each against the oracle's identical counter-based RNG."""
+    if not args.sampled:
+        return [{}] * n
+    return [
+        {} if i % 2 == 0
+        else {"temperature": 0.8, "top_k": 8, "seed": 1000 + i}
+        for i in range(n)
+    ]
 
 
 def _parse_slo(text: Optional[str]):
@@ -493,6 +525,10 @@ def _init_distributed(args) -> None:
         num_processes=args.num_processes,
         process_id=args.process_id,
     )
+    # Force backend creation NOW, on every rank: the global topology
+    # exchange blocks until all processes join, and a router that never
+    # touches jax would otherwise deadlock the whole cluster.
+    jax.devices()
 
 
 def _flight_path(args) -> Optional[str]:
@@ -508,10 +544,20 @@ def _flight_path(args) -> Optional[str]:
 
 def run_multiprocess(args) -> int:
     from chainermn_tpu.serving.cluster import service
+    from chainermn_tpu.serving.cluster.shard_group import plan_groups
 
     _init_distributed(args)
     size = args.num_processes
+    # Shard-group topology (identity when --tp/--pp are 1): replica
+    # ranks partition into consecutive leader+followers runs; the
+    # router only ever talks to leaders.
+    groups = plan_groups(size, args.tp, args.pp)
     if args.role == "replica":
+        group = None
+        if args.tp * args.pp > 1:
+            group = next(
+                g for g in groups if args.process_id in g.ranks
+            )
         role = (args.replica_role or "both")
         out = service.run_replica(
             args.process_id, size, _engine_factory(args),
@@ -519,6 +565,7 @@ def run_multiprocess(args) -> int:
             watermark_blocks=args.watermark,
             flight_path=_flight_path(args),
             metrics_port=args.metrics_port,
+            group=group,
         )
         print(json.dumps({"mode": "replica", "rank": args.process_id,
                           **out}))
@@ -526,21 +573,27 @@ def run_multiprocess(args) -> int:
 
     if args.process_id != 0:
         raise SystemExit("--role router must be --process-id 0")
-    args.replicas = size - 1
+    args.replicas = len(groups)
     prompts = _synthetic_prompts(args)
+    samplings = _request_samplings(args, len(prompts))
     requests = [
         {"prompt": p, "max_new_tokens": args.new_tokens,
-         "timeout_s": args.timeout_s}
-        for p in prompts
+         "timeout_s": args.timeout_s, "sampling": s}
+        for p, s in zip(prompts, samplings)
     ]
     t0 = time.perf_counter()
     results = service.run_router(
         size, requests,
         prefill_threshold=args.prefill_threshold,
+        # Cold jit compiles stall a replica for seconds on CPU; real
+        # deaths are detected much faster via socket EOF -> PeerGone.
+        miss_after_s=args.miss_after_s,
         timeout_s=args.timeout_s,
         flight_path=_flight_path(args),
         metrics_port=args.metrics_port,
         metrics_port_file=args.metrics_port_file,
+        group_size=args.tp,
+        pp_stages=args.pp,
     )
     wall = time.perf_counter() - t0
     extra = {}
@@ -564,19 +617,99 @@ def run_multiprocess(args) -> int:
             )
         }
     if args.verify:
-        oracle = _oracle_streams(args, prompts)
+        oracle = _oracle_streams(args, prompts, samplings)
         mismatches = [
             g for g, o in enumerate(oracle)
             if results[g]["tokens"] != o
         ]
         extra["parity"] = "ok" if not mismatches else "FAIL"
         extra["parity_mismatches"] = mismatches
+        extra["parity_sampled"] = sum(1 for s in samplings if s)
+    if args.tp * args.pp > 1:
+        extra["tp"] = args.tp
+        extra["pp"] = args.pp
+        extra["groups"] = len(groups)
     print(json.dumps(_report(args, results, wall, extra)))
     if extra.get("parity") == "FAIL":
         return 1
     if any(r["status"] != "finished" for r in results.values()):
         return 1
     return 0
+
+
+def run_shard_groups(args) -> int:
+    """``--tp K [--pp S] [--groups G]`` local launcher: spawn the whole
+    shard-group cluster from one shell — this process becomes the
+    router (process 0), plus ``G x K x S`` replica shard processes as
+    children of this one, all joined to an ephemeral jax.distributed
+    coordinator.  ``--verify`` turns on the sampled request legs too,
+    so parity covers greedy AND temperature/top-k decoding."""
+    import os
+    import socket
+    import subprocess
+
+    if args.verify:
+        args.sampled = True
+    size = 1 + args.groups * args.tp * args.pp
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    forward = [
+        "--tp", str(args.tp), "--pp", str(args.pp),
+        "--vocab", str(args.vocab), "--d-model", str(args.d_model),
+        "--heads", str(args.heads), "--d-ff", str(args.d_ff),
+        "--layers", str(args.layers), "--max-len", str(args.max_len),
+        "--block-size", str(args.block_size),
+        "--n-blocks", str(args.n_blocks),
+        "--max-batch", str(args.max_batch),
+        "--max-queue", str(args.max_queue),
+        "--seed", str(args.seed),
+        "--spec-tokens", str(args.spec_tokens),
+        "--timeout-s", str(args.timeout_s),
+    ]
+    if args.prefill_chunk is not None:
+        forward += ["--prefill-chunk", str(args.prefill_chunk)]
+    if args.watermark is not None:
+        forward += ["--watermark", str(args.watermark)]
+    if not args.max_len_growth:
+        forward += ["--no-max-len-growth"]
+    procs = []
+    rc = 1
+    try:
+        for pid in range(1, size):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "chainermn_tpu.tools.serve",
+                 "--role", "replica", "--coordinator", coord,
+                 "--num-processes", str(size), "--process-id", str(pid),
+                 ] + forward,
+                stdout=subprocess.DEVNULL,  # one JSON report: ours
+                env=dict(os.environ),
+            ))
+        args.role = "router"
+        args.coordinator = coord
+        args.num_processes = size
+        args.process_id = 0
+        rc = run_multiprocess(args)
+        return rc
+    finally:
+        deadline = time.perf_counter() + 30
+        killed = False
+        for p in procs:
+            try:
+                p.wait(timeout=max(
+                    0.1, deadline - time.perf_counter()
+                ))
+            except Exception:
+                p.kill()
+                killed = True
+        if killed:
+            # With a killed shard in the world, jax.distributed's
+            # atexit shutdown barrier would hang this (coordinator)
+            # process forever — skip it, the report is already out.
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(rc)
 
 
 def main(argv=None) -> int:
@@ -629,12 +762,36 @@ def main(argv=None) -> int:
                     help="speculative draft length per decode step "
                          "(0 disables; streams are bit-exact either "
                          "way, --verify proves it)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel shard-group width: each "
+                         "replica becomes a leader + tp-1 follower "
+                         "shard processes in lockstep (--role local "
+                         "spawns the whole cluster; router/replica "
+                         "roles must all agree on --tp/--pp)")
+    ap.add_argument("--pp", type=int, default=1,
+                    help="pipeline stages per shard group: decode "
+                         "batches split into per-stage microbatches "
+                         "(bit-exact; group spans tp*pp processes)")
+    ap.add_argument("--groups", type=int, default=1,
+                    help="shard-group count for the --tp local "
+                         "launcher (total processes = 1 + "
+                         "groups*tp*pp)")
+    ap.add_argument("--sampled", action="store_true",
+                    help="make every odd request temperature/top-k "
+                         "sampled instead of greedy (multi-process "
+                         "roles; --tp --verify implies it) so parity "
+                         "covers both decode paths")
     ap.add_argument("--max-queue", type=int, default=64,
                     help="bounded frontend queue size per replica")
     ap.add_argument("--verify", action="store_true",
                     help="replay through a sequential oracle and fail "
                          "unless streams are bit-identical")
     ap.add_argument("--timeout-s", type=float, default=120.0)
+    ap.add_argument("--miss-after-s", type=float, default=30.0,
+                    help="multi-process router: declare a replica dead "
+                         "after this long without a heartbeat (generous "
+                         "default tolerates cold jit compiles on CPU; "
+                         "real deaths surface faster via socket EOF)")
     # autoscaling + generated traffic (local role only)
     ap.add_argument("--traffic", default=None, metavar="SPEC",
                     help="replay a seeded heavy-tailed workload instead "
@@ -696,7 +853,11 @@ def main(argv=None) -> int:
     ap.add_argument("--process-id", type=int, default=None)
     args = ap.parse_args(argv)
 
+    if args.tp < 1 or args.pp < 1 or args.groups < 1:
+        raise SystemExit("--tp/--pp/--groups must be >= 1")
     if args.role == "local":
+        if args.tp * args.pp > 1 or args.groups > 1:
+            return run_shard_groups(args)
         if args.traffic:
             return run_local_traffic(args)
         return run_local(args)

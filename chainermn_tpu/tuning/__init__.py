@@ -63,6 +63,8 @@ from chainermn_tpu.tuning.search_space import (  # noqa: F401
     overlap_schedule_search_space,
     prefill_chunk_cache_key,
     prefill_chunk_search_space,
+    serve_group_cache_key,
+    serve_group_search_space,
 )
 from chainermn_tpu.tuning.autotune import (  # noqa: F401
     lookup_bucket_bytes,
@@ -87,4 +89,5 @@ from chainermn_tpu.tuning.autotune import (  # noqa: F401
     tune_lm_shapes,
     tune_overlap_schedule,
     tune_prefill_chunk,
+    tune_serve_group,
 )

@@ -48,6 +48,8 @@ from chainermn_tpu.tuning.search_space import (
     overlap_schedule_search_space,
     prefill_chunk_cache_key,
     prefill_chunk_search_space,
+    serve_group_cache_key,
+    serve_group_search_space,
 )
 
 
@@ -1286,6 +1288,125 @@ def tune_prefill_chunk(
          "metric": "sum of worst per-step wall time per workload"},
     )
     rec["kernel"] = "prefill_chunk"
+    return rec
+
+
+def tune_serve_group(
+    *,
+    vocab: int = 8192,
+    d_model: int = 1024,
+    n_heads: int = 8,
+    d_ff: int = 4096,
+    n_layers: int = 8,
+    max_len: int = 512,
+    block_size: int = 16,
+    n_blocks: int = 256,
+    batch: int = 4,
+    prompt_len: int = 64,
+    max_new: int = 24,
+    dtype="bfloat16",
+    cache: Optional[TuneCache] = None,
+    n1: int = 1,
+    repeats: int = 3,
+    force: bool = False,
+    dry_run: bool = False,
+    log: Optional[Callable[[str], None]] = None,
+) -> dict:
+    """Tune the serving shard-group SHAPE for one target model family:
+    tensor-parallel group size (registry ``tp`` plan over that many
+    local devices) crossed with pipeline microbatch depth for the
+    decode step.  A fixed continuous-batching workload runs to
+    completion under each candidate; streams are bit-identical across
+    the whole space (per-sequence attention + counter-based sampling +
+    contiguous microbatch splits), so wall time per workload is the
+    entire objective — group shape is a pure throughput decision, like
+    the draft source.  The persisted argmin is what ``tools.serve`` and
+    the router would spend a whole shard group of processes on, priced
+    here on one process's local devices before committing the fleet."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chainermn_tpu.models.transformer import TransformerLM
+    from chainermn_tpu.serving.engine import EngineConfig, InferenceEngine
+    from chainermn_tpu.serving.scheduler import (
+        ContinuousBatchingScheduler,
+        Request,
+    )
+
+    n_devices = len(jax.devices())
+    space = serve_group_search_space(n_heads, d_ff, d_model,
+                                     n_devices, batch)
+    default_cfg = dict(space[0])
+    key = serve_group_cache_key(
+        device_kind(), dtype, vocab, d_model, n_layers, max_len,
+        n_devices, batch,
+    )
+    if dry_run:
+        return {"kernel": "serve_group", "dry_run": True, "key": key,
+                "candidates": space, "default": default_cfg}
+    _require_tuning_allowed("serving shard-group shape")
+    cache = cache or shared_cache()
+    cached = cache.get(key) if not force else None
+    if cached and cached.get("group_size"):
+        return {"kernel": "serve_group", "key": key, "cached": True,
+                "chosen": {"group_size": int(cached["group_size"]),
+                           "pp_stages": int(cached.get(
+                               "pp_stages", 1))}}
+
+    dt = getattr(jnp, dtype_name(dtype))
+    lm = TransformerLM(vocab=vocab, d_model=d_model, n_heads=n_heads,
+                       d_ff=d_ff, n_layers=n_layers, max_len=max_len,
+                       dtype=dt)
+    params = lm.init(jax.random.PRNGKey(0),
+                     jnp.zeros((1, 8), jnp.int32))
+    rng = np.random.RandomState(0)
+    prompts = [
+        list(rng.randint(1, vocab, size=prompt_len).astype(int))
+        for _ in range(batch)
+    ]
+    if log:
+        log(f"serve_group {key}: {len(space)} candidates "
+            f"({n_devices} local devices)")
+
+    def build(cfg):
+        plan = mesh = None
+        if cfg["group_size"] > 1:
+            from jax.sharding import Mesh
+
+            plan = "tp"
+            mesh = Mesh(
+                np.asarray(jax.devices()[: cfg["group_size"]]),
+                ("model",),
+            )
+        ecfg = EngineConfig(block_size=block_size, n_blocks=n_blocks,
+                            max_len=max_len, max_batch=batch)
+        engine = InferenceEngine(lm, params, ecfg, plan=plan, mesh=mesh)
+        engine.pp_stages = int(cfg["pp_stages"])
+
+        def run(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                sched = ContinuousBatchingScheduler(engine)
+                for i, p in enumerate(prompts):
+                    sched.add_request(Request(
+                        request_id=i, prompt=list(p),
+                        max_new_tokens=max_new))
+                while sched.has_work:
+                    sched.step()
+            return time.perf_counter() - t0
+
+        return run
+
+    results = measure_candidates(build, space, n1=n1, repeats=repeats,
+                                 log=log)
+    rec = _finish(
+        key, results, default_cfg, cache,
+        {"kernel": "serve_group", "dtype": dtype_name(dtype),
+         "vocab": vocab, "d_model": d_model, "n_layers": n_layers,
+         "max_len": max_len, "batch": batch,
+         "n_devices": n_devices},
+    )
+    rec["kernel"] = "serve_group"
     return rec
 
 

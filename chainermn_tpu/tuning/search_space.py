@@ -447,6 +447,51 @@ def layout_search_space(mesh_axes, params=None, mesh=None) -> List[dict]:
     return out
 
 
+def serve_group_search_space(n_heads: int, d_ff: int, d_model: int,
+                             n_devices: int,
+                             max_batch: int) -> List[dict]:
+    """Candidate ``{"group_size", "pp_stages"}`` shard-group shapes for
+    the serving cluster: how many tensor-parallel shards one replica
+    spans (the registry ``tp`` plan over that many devices) crossed
+    with how many pipeline microbatch stages the decode batch splits
+    into.  ``{1, 1}`` (today's single-shard replica) is pinned first as
+    the static default; group sizes must divide the model's heads, FFN
+    and width AND fit the local device count, pipeline depths must
+    leave each microbatch at least one row.  Bit-exactness makes every
+    candidate produce identical streams — wall time per workload is the
+    whole trade."""
+    out = [{"group_size": 1, "pp_stages": 1}]
+    groups = [1] + [
+        k for k in (2, 4)
+        if k <= int(n_devices)
+        and n_heads % k == 0 and d_ff % k == 0 and d_model % k == 0
+    ]
+    stages = [1] + [s for s in (2, 4) if s <= int(max_batch)]
+    for k in groups:
+        for s in stages:
+            cfg = {"group_size": k, "pp_stages": s}
+            if cfg not in out:
+                out.append(cfg)
+    return out
+
+
+def serve_group_cache_key(dev_kind: str, dtype, vocab: int, d_model: int,
+                          n_layers: int, max_len: int, n_devices: int,
+                          max_batch: int) -> str:
+    """Cache key for the shard-group shape: model family (pow2-bucketed
+    like the draft key), the serving context budget, and — unlike the
+    single-engine tuners — the local device count and decode batch
+    ceiling, since they bound the candidate set itself."""
+    return make_key(
+        "serve_group",
+        dev_kind,
+        dtype,
+        (("v", bucket_pow2(vocab)), ("d", bucket_pow2(d_model)),
+         ("l", int(n_layers)), ("c", bucket_pow2(max_len))),
+        {"dev": str(int(n_devices)), "b": str(int(max_batch))},
+    )
+
+
 def layout_cache_key(dev_kind: str, dtype, n_params: int, n_leaves: int,
                      mesh_shape, model: str = "transformer_lm") -> str:
     """Cache key for the layout search: parameter count and leaf count

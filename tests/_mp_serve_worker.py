@@ -53,6 +53,18 @@ which the router only knows is warm through the gossiped partial
 prefix.  Rank 0 prints ``SERVE_LONGCTX_OK holder=<rank>`` before
 ``SERVE_SOAK_OK``.
 
+With the literal argument ``tpgroup`` the fleet runs TWO tensor-
+parallel shard groups (router + 2 groups x 2 shard processes: leaders
+at ranks 1 and 3, followers at 2 and 4) and the doomed process is a
+*follower* shard: rank 2 SIGKILLs itself after replaying ``kill_after``
+mirrored device steps — mid-stream, lockstep state live.  The leader's
+next mirror fan-out (or beat poll) raises PeerGone, it exits its serve
+loop, the router sees the GROUP die on the leader's event edge, and the
+orphaned streams re-place on the survivor group — every stream still
+bit-identical to the sequential oracle, the survivor leader's pool
+passing assert_consistent on clean stop.  Rank 0 prints
+``SERVE_TPGROUP_OK survivor=<leader>`` before ``SERVE_SOAK_OK``.
+
 With the argument ``metrics:<dir>`` the default kill9 soak additionally
 exercises the fleet observability plane over the wire: every request
 carries a tenant id, the router serves its merged fleet view at a live
@@ -81,8 +93,10 @@ def main():
     traffic = flight_dir == "traffic"
     gossip = flight_dir == "gossip"
     longctx = flight_dir == "longctx"
+    tpgroup = flight_dir == "tpgroup"
     flight_path = None
-    if flight_dir and not traffic and not gossip and not longctx:
+    if flight_dir and not traffic and not gossip and not longctx \
+            and not tpgroup:
         flight_path = os.path.join(flight_dir, f"flight_{pid}.jsonl")
 
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
@@ -255,6 +269,7 @@ def main():
             nproc, requests, miss_after_s=30.0, timeout_s=180.0,
             flight_path=flight_path, reporter=reporter, slo=slo,
             metrics_port_file=metrics_port_file,
+            group_size=2 if tpgroup else 1,
         )
         if scraper is not None:
             stop_scraping.set()
@@ -270,6 +285,19 @@ def main():
                 failovers += rr["failovers"]
             if kill_after > 0:
                 assert failovers > 0, "nobody failed over despite kill"
+            if tpgroup:
+                # The follower-shard kill must have collapsed the WHOLE
+                # group led by rank 1: every stream that failed over
+                # finished on the survivor group's leader (rank 3), and
+                # the survivor leader's clean-stop assert_consistent
+                # (inside run_replica) proves its pool absorbed the
+                # orphans without leaking a page.
+                moved = [g for g, _ in enumerate(prompts)
+                         if results[g]["failovers"] > 0]
+                assert moved, results
+                for g in moved:
+                    assert results[g]["replica"] == 3, (g, results[g])
+                print("SERVE_TPGROUP_OK survivor=3")
             if gossip:
                 # The template request must have outlived rank 1's
                 # SIGKILL on a survivor, and BOTH gated wave-2 requests
@@ -352,6 +380,23 @@ def main():
     # gossip mode the doomed rank is 1 — the cold-start favorite that
     # owns the template request — and max_queue=2 spreads wave 1 over
     # all three replicas.
+    if tpgroup:
+        # Two shard groups of 2: leaders 1 and 3, followers 2 and 4.
+        # The doomed process is FOLLOWER rank 2 — it dies after
+        # replaying kill_after mirrored steps, which must take down the
+        # whole group led by rank 1.
+        from chainermn_tpu.serving.cluster.shard_group import plan_groups
+
+        group = next(g for g in plan_groups(nproc, 2, 1)
+                     if pid in g.ranks)
+        out = service.run_replica(
+            pid, nproc, engine_factory, max_queue=3, group=group,
+            kill_after_ops=kill_after if (kill_after > 0 and pid == 2)
+            else None,
+        )
+        print(f"SERVE_REPLICA_OK {pid} {out['reason']}")
+        sys.stdout.flush()
+        os._exit(0)
     doomed = kill_after > 0 and pid == (1 if gossip else nproc - 1)
     out = service.run_replica(
         pid, nproc, engine_factory,

@@ -356,6 +356,38 @@ def test_serving_cluster_clean_run_no_kill():
     assert "SERVE_REPLICA_OK 2" in outs[2]
 
 
+def test_serving_tp_shard_group_survives_follower_kill9():
+    """The shard-group soak: router + TWO tensor-parallel groups of 2
+    processes each (leaders 1 and 3, followers 2 and 4), and the doomed
+    process is a FOLLOWER — rank 2 SIGKILLs itself after replaying 4
+    mirrored device steps, mid-stream, lockstep mirrors live.  The
+    leader must detect the dead shard (PeerGone on the mirror fan-out
+    or beat poll) and exit, the router must fail the WHOLE group on the
+    leader's event edge, and the orphaned streams must re-place on the
+    survivor group — every stream bit-identical to the sequential
+    single-engine oracle, the survivor leader's pool passing
+    assert_consistent on clean stop."""
+    procs, outs = _launch(_SERVE_WORKER, 5, "4", "tpgroup",
+                          n_devices=1, timeout=420)
+    codes = [p.returncode for p in procs]
+    assert codes[2] == -9, \
+        f"follower rank 2 should die by SIGKILL: {codes}\n" \
+        + "\n".join(outs)
+    assert codes[0] == 0, f"router failed:\n{outs[0]}"
+    assert "SERVE_SOAK_OK" in outs[0], outs[0]
+    assert "SERVE_TPGROUP_OK survivor=3" in outs[0], outs[0]
+    # The doomed group's LEADER exits alive but reports the follower
+    # death — any-shard death fails the whole group.
+    assert codes[1] == 0, f"doomed group leader crashed:\n{outs[1]}"
+    assert "SERVE_REPLICA_OK 1 follower gone" in outs[1], outs[1]
+    # Survivor group: leader stops cleanly (assert_consistent inside),
+    # its follower replays to the end and stops on the leader's signal.
+    assert codes[3] == 0, f"survivor leader failed:\n{outs[3]}"
+    assert "SERVE_REPLICA_OK 3 stopped" in outs[3], outs[3]
+    assert codes[4] == 0, f"survivor follower failed:\n{outs[4]}"
+    assert "SERVE_REPLICA_OK 4 stopped" in outs[4], outs[4]
+
+
 def test_serving_traffic_soak_kill_at_peak_load():
     """The chaos-under-load soak: the fleet serves a seeded
     heavy-tailed workload (MMPP bursts, Zipf shared prefixes, mixed

@@ -603,3 +603,49 @@ def test_circular_rejects_bad_round(pp_mesh):
     )
     with pytest.raises(ValueError, match="rounds"):
         jax.jit(f)(per_dev, x, tgt)
+
+
+# ---------------------------------------------------------------------------
+# Serving decode microbatches: the tp x pp composition's host-side split
+# ---------------------------------------------------------------------------
+
+
+def test_decode_microbatches_contiguous_even_with_leading_remainder():
+    from chainermn_tpu.parallel.pipeline import decode_microbatches
+
+    assert decode_microbatches(4, 2) == [(0, 2), (2, 4)]
+    assert decode_microbatches(5, 2) == [(0, 3), (3, 5)]   # rem leads
+    assert decode_microbatches(7, 3) == [(0, 3), (3, 5), (5, 7)]
+    # fewer rows than stages: one row per span, never an empty span
+    assert decode_microbatches(2, 4) == [(0, 1), (1, 2)]
+    assert decode_microbatches(1, 4) == [(0, 1)]
+    assert decode_microbatches(0, 4) == []
+    # degenerate pipeline: the whole batch is one step
+    assert decode_microbatches(6, 1) == [(0, 6)]
+    # exhaustive contiguity/coverage sweep
+    for n in range(1, 9):
+        for s in range(1, 5):
+            spans = decode_microbatches(n, s)
+            assert spans[0][0] == 0 and spans[-1][1] == n
+            assert all(a2 == b1 for (_, b1), (a2, _) in
+                       zip(spans, spans[1:]))
+            sizes = [b - a for a, b in spans]
+            assert max(sizes) - min(sizes) <= 1
+            assert all(sz > 0 for sz in sizes)
+
+
+def test_serve_pipeline_order_is_gpipe_wavefront():
+    from chainermn_tpu.parallel.pipeline import serve_pipeline_order
+
+    order = serve_pipeline_order(3, 2)
+    # microbatch m enters stage s at tick m + s
+    assert order == [(0, 0, 0), (1, 0, 1), (1, 1, 0), (2, 0, 2),
+                     (2, 1, 1), (3, 1, 2)]
+    for n_micro, n_stages in ((1, 1), (4, 2), (2, 3)):
+        o = serve_pipeline_order(n_micro, n_stages)
+        # every (stage, micro) pair exactly once
+        assert len(o) == n_micro * n_stages
+        assert len({(s, m) for _, s, m in o}) == n_micro * n_stages
+        assert all(t == s + m for t, s, m in o)
+        # fill-drain latency: last tick is the GPipe bound
+        assert o[-1][0] == n_micro + n_stages - 2

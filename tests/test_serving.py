@@ -50,6 +50,10 @@ SP_CENSUS_GOLDEN_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)),
     "golden", "serving_sp_prefill_census.json",
 )
+TP_CENSUS_GOLDEN_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "golden", "serving_tp_decode_census.json",
+)
 
 VOCAB = 32
 
@@ -1226,6 +1230,73 @@ def test_sp_prefill_collective_census_matches_golden():
                if k != "all_gather")
 
 
+def _tp_decode_census() -> dict:
+    """Census of the tensor-parallel decode step's COMPILED HLO.
+
+    The ``tp`` plan shards by NamedSharding annotation, so its
+    collectives exist only after GSPMD partitioning — ``audit_fn``'s
+    jaxpr view sees zero.  The per-layer count is pinned by differencing
+    a 2-layer program against the 1-layer one, and the sampling tail
+    (argmax over the replicated fp32 logits) is audited separately: the
+    leader samples locally, so the tail must stay collective-free."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from chainermn_tpu.analysis.fixtures import fixture_tp_decode
+    from chainermn_tpu.observability import audit_compiled
+
+    assert len(jax.devices()) >= 2, "TP census needs >= 2 devices"
+    audits = {}
+    for n_layers in (1, 2):
+        t = fixture_tp_decode(n_layers=n_layers)
+        audits[n_layers] = audit_compiled(t["fn"], *t["args"])
+    c1, c2 = audits[1].census(), audits[2].census()
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("model",))
+    logits = jax.ShapeDtypeStruct(
+        (2, VOCAB), jnp.float32,
+        sharding=NamedSharding(mesh, PartitionSpec()),
+    )
+    tail = audit_compiled(
+        jax.jit(lambda x: jnp.argmax(x, axis=-1).astype(jnp.int32)),
+        logits,
+    )
+    return {
+        "target": "tp_decode",
+        "hlo_collectives": c1,
+        "per_layer_collectives": {k: c2[k] - c1[k] for k in sorted(c1)},
+        "reduction_collectives": audits[1].reduction_collectives(),
+        "sampling_tail_collectives": tail.census(),
+        "sampling_tail_reduction_collectives": tail.reduction_collectives(),
+    }
+
+
+def test_tp_decode_collective_census_matches_golden():
+    """The TP decode step's wire cost is pinned at the compiled-HLO
+    level: exactly two all-reduces per layer (attention out-projection
+    and FFN down-projection — the canonical Megatron-style partition),
+    no gathers or permutes, and a collective-free sampling tail.  Any
+    drift means GSPMD stopped partitioning the decode step the way the
+    shard-group design assumes."""
+    with open(TP_CENSUS_GOLDEN_PATH) as f:
+        golden = json.load(f)
+    current = _tp_decode_census()
+    assert current == golden, (
+        "tp-decode collective census drifted — the GSPMD partition of "
+        "the shard-group decode step changed.  If the new lowering is "
+        "intended (check the per-layer count stayed O(1)), regenerate "
+        f"with: python {__file__} --regen"
+    )
+    # the golden itself must pin the Megatron shape (guards a bad regen)
+    per_layer = golden["per_layer_collectives"]
+    assert per_layer["psum"] == 2
+    assert all(v == 0 for k, v in per_layer.items() if k != "psum")
+    assert golden["reduction_collectives"] > 0
+    # sampling must never pay for the tensor parallelism
+    assert golden["sampling_tail_reduction_collectives"] == 0
+    assert all(
+        v == 0 for v in golden["sampling_tail_collectives"].values()
+    )
+
+
 # ---------------------------------------------------------------------------
 # Subprocess smokes: bench --serve, the example
 # ---------------------------------------------------------------------------
@@ -1255,6 +1326,38 @@ def test_bench_serve_emits_decode_throughput_json():
         assert row["tokens_per_sec"] > 0
         assert row["p50_token_latency_ms"] is not None
         assert row["p99_token_latency_ms"] >= row["p50_token_latency_ms"]
+
+
+def test_bench_serve_tp_emits_group_size_curve():
+    """--serve-tp rides along additively: the usual --serve report plus
+    a "tp" section whose curve covers every valid group size with a
+    speedup relative to the K=1 baseline, and sizes the local device
+    count can't host reported as skipped, not dropped."""
+    from conftest import subprocess_env
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--serve",
+         "--serve-tp", "--serve-tp-sizes", "1,2,4",
+         "--lm-vocab", "32", "--lm-d-model", "16", "--lm-heads", "2",
+         "--lm-d-ff", "32", "--lm-layers", "1",
+         "--serve-batch-sizes", "2", "--serve-requests", "3",
+         "--serve-prompt-len", "6", "--serve-new-tokens", "4",
+         "--serve-block-size", "4", "--serve-blocks", "32",
+         "--serve-max-len", "32"],
+        capture_output=True, text=True, timeout=420,
+        env=subprocess_env(n_devices=2), cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.splitlines()[-1])
+    tp = out["tp"]
+    assert tp["devices"] == 2
+    assert [r["group_size"] for r in tp["curve"]] == [1, 2]
+    for r in tp["curve"]:
+        assert r["finished"] == 3 and r["tokens_per_sec"] > 0
+        assert r["speedup"] > 0
+    # K=4 exceeds both devices and head count: reported, not dropped
+    assert [s["group_size"] for s in tp["skipped"]] == [4]
 
 
 def test_serve_lm_example_smoke():
@@ -1332,10 +1435,19 @@ def test_serving_soak_shared_prefix_spec_churn(lm, lm_params, oracle):
 # --regen
 # ---------------------------------------------------------------------------
 def _regen():
+    # Outside pytest, conftest's device-count flag hasn't run; set it
+    # before the first backend touch or the tp mesh degenerates to one
+    # device and the TP census regenerates as all-zero.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        )
     jax.config.update("jax_platforms", "cpu")
     os.makedirs(os.path.dirname(CENSUS_GOLDEN_PATH), exist_ok=True)
     for path, census in ((CENSUS_GOLDEN_PATH, _decode_census()),
-                         (SP_CENSUS_GOLDEN_PATH, _sp_prefill_census())):
+                         (SP_CENSUS_GOLDEN_PATH, _sp_prefill_census()),
+                         (TP_CENSUS_GOLDEN_PATH, _tp_decode_census())):
         with open(path, "w") as f:
             json.dump(census, f, indent=2, sort_keys=True)
             f.write("\n")

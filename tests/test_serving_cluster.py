@@ -485,6 +485,189 @@ def test_prefix_digest_content_addressed_and_defrag_stable():
     assert kv.match_prefix(toks)                 # index still serves
 
 
+def test_prefix_digest_tenant_salt_isolates_namespaces():
+    """The tenant namespace salts the digest AND the index key: the
+    same token run digests differently per namespace, None reproduces
+    the historical unsalted digest, and a registration in one namespace
+    never matches from another — per-tenant prefix isolation is
+    content-addressing, not an ACL bolted on top."""
+    from chainermn_tpu.serving import PagedKVCache, prefix_digest, \
+        prompt_digests
+
+    toks = list(range(12))
+    assert prefix_digest(toks) == prefix_digest(toks, namespace=None)
+    da, db = prefix_digest(toks, "ta"), prefix_digest(toks, "tb")
+    assert len({prefix_digest(toks), da, db}) == 3
+    assert prompt_digests(toks, 4, namespace="ta") == [
+        prefix_digest(toks[:4], "ta"), prefix_digest(toks[:8], "ta"),
+        da,
+    ]
+    kv = PagedKVCache(16, 4)
+    kv.allocate("a", 12)
+    kv.register_prefix("a", toks, namespace="ta")
+    assert kv.match_prefix(toks, namespace="ta")
+    assert kv.match_prefix(toks, namespace="tb") == []
+    assert kv.match_prefix(toks) == []           # default namespace too
+    assert da in kv.prefix_digests()
+
+
+def test_request_prefix_namespace_follows_tenant_unless_shared():
+    """A request's prefix pages index under its tenant by default;
+    ``shared_prefix`` opts into the unsalted shared namespace (the
+    common-system-prompt case), and untenanted requests land there
+    already."""
+    r = Request(request_id="r", prompt=[1], max_new_tokens=1,
+                tenant="ta")
+    assert r.prefix_namespace == "ta"
+    s = Request(request_id="s", prompt=[1], max_new_tokens=1,
+                tenant="ta", shared_prefix=True)
+    assert s.prefix_namespace is None
+    t = Request(request_id="t", prompt=[1], max_new_tokens=1)
+    assert t.prefix_namespace is None
+
+
+def test_scheduler_tenant_prefix_isolation_and_shared_optin(lm,
+                                                            lm_params):
+    """Two tenants submitting the SAME prompt must not share prefix
+    pages (zero cross-tenant prefix hits); with ``shared_prefix`` both
+    land in the shared namespace and the second reuses the first's
+    pages.  Streams are bit-identical throughout — isolation changes
+    page accounting, never tokens."""
+    from chainermn_tpu.serving import ContinuousBatchingScheduler
+
+    prompt = [int(t) for t in
+              np.random.default_rng(3).integers(0, VOCAB, size=9)]
+    want = oracle_streams(lm, lm_params, [prompt], 5)[0]
+
+    def run(shared):
+        eng = make_engine(lm, lm_params)
+        sched = ContinuousBatchingScheduler(eng)
+        # sequential, so the second tenant's prompt arrives AFTER the
+        # first's prefix pages are registered — a hit iff shareable
+        for i, ten in enumerate(("ta", "tb")):
+            sched.add_request(Request(
+                request_id=f"r{i}", prompt=list(prompt),
+                max_new_tokens=5, tenant=ten, shared_prefix=shared))
+            while sched.has_work:
+                sched.step()
+        assert [r.generated for r in sched.results().values()] \
+            == [want, want]
+        return eng._tokens_prefix_cached
+
+    assert run(shared=False) == 0          # isolated: no reuse
+    assert run(shared=True) > 0            # opted in: pages shared
+
+
+# ---------------------------------------------------------------------------
+# Shard groups: plan_groups, lockstep mirroring, pipelined decode
+# ---------------------------------------------------------------------------
+
+
+def test_plan_groups_partitions_ranks_into_leader_led_runs():
+    from chainermn_tpu.serving.cluster import plan_groups
+
+    groups = plan_groups(5, group_size=2)
+    assert [g.leader for g in groups] == [1, 3]
+    assert [g.followers for g in groups] == [(2,), (4,)]
+    assert all(g.group_size == 2 and g.pp_stages == 1 for g in groups)
+    assert groups[0].ranks == (1, 2) and groups[0].n_shards == 2
+
+    # tp x pp: shard count is the product
+    tp_pp = plan_groups(5, group_size=2, pp_stages=2)
+    assert len(tp_pp) == 1 and tp_pp[0].ranks == (1, 2, 3, 4)
+    assert tp_pp[0].n_shards == 4 and tp_pp[0].pp_stages == 2
+
+    # K=1 degenerates to today's one-process replicas
+    solo = plan_groups(4)
+    assert [g.leader for g in solo] == [1, 2, 3]
+    assert all(g.followers == () for g in solo)
+
+    with pytest.raises(ValueError):
+        plan_groups(4, group_size=2)     # 3 ranks don't split into 2s
+    with pytest.raises(ValueError):
+        plan_groups(2, group_size=2)     # not even one full group
+
+
+def test_engine_mirror_replay_lockstep_parity(lm, lm_params):
+    """The shard-group invariant, single-process: a follower that only
+    replays the leader's mirrored device steps (prefill / decode /
+    chunk / cow / defrag) over its own identically-seeded params ends
+    the workload with a BIT-IDENTICAL KV cache — no scheduler, no
+    sampler, no block tables of its own.  Mixed greedy + sampled
+    traffic with a shared prefix, so the replay covers the chunk
+    (suffix prefill) and CoW (rewind) ops, not just the easy two."""
+    from chainermn_tpu.serving import ContinuousBatchingScheduler
+
+    leader = make_engine(lm, lm_params)
+    follower = make_engine(lm, lm_params)
+    ops = []
+    leader.mirror_sink = lambda op, payload: ops.append((op, payload))
+
+    rng = np.random.default_rng(11)
+    shared = [int(t) for t in rng.integers(0, VOCAB, size=8)]
+    sched = ContinuousBatchingScheduler(leader)
+    for i in range(3):
+        # r2's prompt IS the shared prefix: fully cached, so the
+        # scheduler takes the CoW-rewind path ("cow" coverage).
+        tail = ([int(t) for t in rng.integers(0, VOCAB, size=3 + i)]
+                if i < 2 else [])
+        sched.add_request(Request(
+            request_id=f"r{i}", prompt=shared + tail, max_new_tokens=6,
+            sampling=(SamplingParams() if i % 2 == 0 else
+                      SamplingParams(temperature=0.9, top_k=8,
+                                     seed=100 + i)),
+        ))
+        while sched.has_work:
+            sched.step()
+    # Deterministic fragmentation: compact first, then leave a hole
+    # below a live allocation so this defragment MUST move pages.
+    leader.defragment()
+    leader.kv.allocate("x", 8)
+    leader.kv.allocate("y", 8)
+    leader.kv.free("x")
+    assert leader.defragment() > 0
+
+    assert {op for op, _ in ops} >= {"prefill", "decode", "chunk",
+                                     "cow", "defrag"}
+    for op, payload in ops:
+        follower.apply_step(op, payload)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        leader._cache, follower._cache,
+    )
+    with pytest.raises(ValueError):
+        follower.apply_step("nonsense", ())
+
+
+def test_pp_microbatched_decode_streams_bit_exact(lm, lm_params):
+    """Splitting the decode batch into pipeline microbatches must not
+    change a single token: per-sequence attention + counter-based
+    sampling make each row's result independent of batch composition,
+    so the contiguous-span split is bit-exact by construction.  This is
+    the invariant that lets pp_stages be a pure throughput knob."""
+    from chainermn_tpu.serving import ContinuousBatchingScheduler
+
+    prompts = prompts_for(4, rng_seed=23)
+    want = oracle_streams(lm, lm_params, prompts, 6)
+
+    def run(pp):
+        eng = make_engine(lm, lm_params)
+        eng.pp_stages = pp
+        sched = ContinuousBatchingScheduler(eng)
+        for i, p in enumerate(prompts):
+            sched.add_request(Request(
+                request_id=i, prompt=list(p), max_new_tokens=6))
+        while sched.has_work:
+            sched.step()
+        res = sched.results()
+        return [res[i].generated for i in range(len(prompts))]
+
+    assert run(1) == want
+    assert run(2) == want
+    assert run(3) == want
+
+
 def test_prefix_gossip_versioned_anti_entropy():
     """Snapshots apply strictly-newer only: duplicates and reordered
     deliveries are no-ops, so load-beat gossip is idempotent."""

@@ -314,6 +314,39 @@ def test_flash_bwd_blocks_numerics_match():
 # ---------------------------------------------------------------------------
 
 
+def test_serve_group_search_space_validity_and_default():
+    from chainermn_tpu.tuning import serve_group_search_space
+
+    space = serve_group_search_space(8, 4096, 1024, n_devices=4,
+                                     max_batch=4)
+    assert space[0] == {"group_size": 1, "pp_stages": 1}  # static default
+    assert {"group_size": 4, "pp_stages": 4} in space
+    for cfg in space:
+        assert cfg["group_size"] <= 4 and 8 % cfg["group_size"] == 0
+        assert cfg["pp_stages"] <= 4
+    # geometry gates: odd head count kills K=2/4; device count caps K
+    assert all(c["group_size"] == 1 for c in
+               serve_group_search_space(3, 4096, 1024, 8, 4))
+    assert all(c["group_size"] <= 2 for c in
+               serve_group_search_space(8, 4096, 1024, 2, 4))
+    # batch of 1 leaves no microbatches to pipeline
+    assert all(c["pp_stages"] == 1 for c in
+               serve_group_search_space(8, 4096, 1024, 4, 1))
+
+
+def test_tune_serve_group_dry_run_enumerates_without_timing(tmp_path,
+                                                            monkeypatch):
+    from chainermn_tpu.tuning import tune_serve_group
+
+    cache_file = tmp_path / "tune.json"
+    monkeypatch.setenv(ENV_CACHE_PATH, str(cache_file))
+    out = tune_serve_group(dry_run=True)
+    assert out["dry_run"] and out["kernel"] == "serve_group"
+    assert out["default"] == {"group_size": 1, "pp_stages": 1}
+    assert out["default"] in out["candidates"]
+    assert not cache_file.exists()
+
+
 def test_tune_lm_shapes_dry_run_times_nothing(tmp_path, monkeypatch):
     """dry_run enumerates the spaces with no compilation, no timing and
     no cache writes — and is allowed even where tuning is disabled."""
