@@ -6,7 +6,10 @@ census: trace any step function (or take an existing jaxpr /
 divergence (R001), unreduced gradients (R002), narrow-dtype reductions
 (R003), bucketing regressions (R004), missing buffer donation (R005),
 sharding-plan coverage (R006) — producing structured findings *before*
-the first step runs.
+the first step runs.  The host plane gets the same treatment in
+:mod:`chainermn_tpu.analysis.hostlint` (H001–H005: lock discipline,
+blocking-under-lock, mirror-before-execute, wire-schema lock,
+determinism taint) via :func:`analyze_host` / ``tools.lint --host``.
 
 Surfaces:
 
@@ -39,4 +42,6 @@ from chainermn_tpu.analysis.core import (  # noqa: F401
     list_rules,
     register_rule,
 )
+from chainermn_tpu.analysis.hostlint import analyze_host  # noqa: F401
 from chainermn_tpu.analysis import rules  # noqa: F401  (registers R001–R006)
+from chainermn_tpu.analysis import hostlint  # noqa: F401  (registers H001–H005)

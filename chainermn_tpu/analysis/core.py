@@ -255,6 +255,9 @@ class LintContext:
     #: by :func:`analyze_plan`, absent on fn/jaxpr entry points.
     plan: Any = None
     plan_params: Any = None
+    #: host-plane source corpus (H001–H005); set by
+    #: :func:`chainermn_tpu.analysis.hostlint.analyze_host`.
+    host: Any = None
     _events: Optional[List[CollectiveEvent]] = None
 
     @property
@@ -284,6 +287,8 @@ class LintContext:
             return self.arg_leaf_avals is not None
         if req == "plan":
             return self.plan is not None and self.plan_params is not None
+        if req == "host":
+            return self.host is not None
         return False
 
 

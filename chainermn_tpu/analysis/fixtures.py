@@ -10,6 +10,10 @@ all-gathers (never a reduction), and
 schedule's ``all-reduce-start``/``-done`` pairs as ONE collective each
 instead of misdiagnosing them as a bucketing regression.
 
+The host plane has the same corpus shape: one violating + one clean
+``source=`` snippet per H-rule (``h001``…``h005_clean``), linted
+through :func:`chainermn_tpu.analysis.hostlint.analyze_host`.
+
 These are the linter's own regression corpus — ``python -m
 chainermn_tpu.tools.lint --fixtures`` lints them (and must exit
 nonzero — the violations dominate), ``tests/test_analysis.py`` asserts
@@ -531,6 +535,199 @@ def fixture_tp_decode(n_layers: int = 1) -> dict:
     )
 
 
+# ----------------------------------------------------------------------
+# Host-plane fixtures (H001–H005): one violating + one clean snippet per
+# rule, linted as ``source=`` targets through hostlint.analyze_host.
+# ----------------------------------------------------------------------
+_H001_BAD = '''\
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.value = 0
+
+    def bump(self):
+        with self.lock:
+            self.value += 1
+
+    def reset(self):
+        self.value = 0
+'''
+
+_H001_OK = _H001_BAD.replace(
+    "    def reset(self):\n        self.value = 0\n",
+    "    def reset(self):\n        with self.lock:\n"
+    "            self.value = 0\n",
+)
+
+_H002_BAD = '''\
+import threading
+import time
+
+
+class Sender:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self._sock = sock
+
+    def push(self, payload):
+        with self._lock:
+            time.sleep(0.05)
+            self._sock.sendall(payload)
+'''
+
+_H002_OK = '''\
+import threading
+
+
+class Sender:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self._sock = sock
+        self._seq = 0
+
+    def push(self, payload):
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+        self._sock.sendall((seq, payload))
+'''
+
+_H003_BAD = '''\
+class MiniEngine:
+    def __init__(self, decode_jit):
+        self._cache = None
+        self.mirror_sink = None
+        self._decode_jit = decode_jit
+
+    def _mirror(self, op, *payload):
+        if self.mirror_sink is not None:
+            self.mirror_sink(op, payload)
+
+    def decode(self, tokens):
+        out = self._decode_jit(tokens, self._cache)
+        self._cache = out[1]
+        self._mirror("decode", tokens)
+        return out[0]
+'''
+
+_H003_OK = '''\
+class MiniEngine:
+    def __init__(self, decode_jit):
+        self._cache = None
+        self.mirror_sink = None
+        self._decode_jit = decode_jit
+
+    def _mirror(self, op, *payload):
+        if self.mirror_sink is not None:
+            self.mirror_sink(op, payload)
+
+    def decode(self, tokens):
+        self._mirror("decode", tokens)
+        out = self._decode_jit(tokens, self._cache)
+        self._cache = out[1]
+        return out[0]
+'''
+
+_H004_SRC = '''\
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class HeartbeatFrame:
+    host: str
+    port: int
+    seq: int = 0
+'''
+
+#: lockfile claiming (host, seq, port) — the source above reordered the
+#: trailing fields, which breaks positional decode on old receivers.
+_H004_BAD_LOCK = {"schemas": {"dataclass:HeartbeatFrame": {
+    "fields": [["host", False], ["seq", False], ["port", False]],
+}}}
+
+#: lockfile from one release earlier — the source appended ``seq`` WITH
+#: a default, the sanctioned wire evolution, so nothing fires.
+_H004_OK_LOCK = {"schemas": {"dataclass:HeartbeatFrame": {
+    "fields": [["host", False], ["port", False]],
+}}}
+
+_H005_BAD = '''\
+import random
+import time
+
+
+def pick_victim(blocks):
+    if random.random() < 0.5:
+        return blocks[0]
+    return blocks[int(time.time()) % len(blocks)]
+'''
+
+_H005_OK = '''\
+import numpy as np
+
+
+def pick_victim(blocks, seed, step):
+    rng = np.random.default_rng((seed, step))
+    return blocks[int(rng.integers(len(blocks)))]
+'''
+
+
+def fixture_h001() -> dict:
+    """Mixed guarded/bare access: ``value`` is incremented under the
+    lock but reset bare — the reset can land mid-increment."""
+    return dict(target="h001", expect="H001", source=_H001_BAD)
+
+
+def fixture_h001_clean() -> dict:
+    return dict(target="h001_clean", expect=None, source=_H001_OK)
+
+
+def fixture_h002() -> dict:
+    """A sleep and a socket send inside the lock — every other thread
+    convoys behind network latency."""
+    return dict(target="h002", expect="H002", source=_H002_BAD)
+
+
+def fixture_h002_clean() -> dict:
+    return dict(target="h002_clean", expect=None, source=_H002_OK)
+
+
+def fixture_h003() -> dict:
+    """Mirror emitted only AFTER the jit step + cache assignment — a
+    follower that detaches between the two replays a shorter prefix."""
+    return dict(target="h003", expect="H003", source=_H003_BAD)
+
+
+def fixture_h003_clean() -> dict:
+    return dict(target="h003_clean", expect=None, source=_H003_OK)
+
+
+def fixture_h004() -> dict:
+    """Field reorder against the lockfile: positional decode on an
+    old receiver reads ``port`` where ``seq`` was promised."""
+    return dict(target="h004", expect="H004", source=_H004_SRC,
+                wire=True, wire_lock=_H004_BAD_LOCK)
+
+
+def fixture_h004_clean() -> dict:
+    return dict(target="h004_clean", expect=None, source=_H004_SRC,
+                wire=True, wire_lock=_H004_OK_LOCK)
+
+
+def fixture_h005() -> dict:
+    """Global RNG + wall-clock in a defrag victim pick — replicas
+    replaying the same op stream choose different victims."""
+    return dict(target="h005", expect="H005", source=_H005_BAD, det=True)
+
+
+def fixture_h005_clean() -> dict:
+    return dict(target="h005_clean", expect=None, source=_H005_OK,
+                det=True)
+
+
 FIXTURES: Dict[str, Callable[[], dict]] = {
     "r001": fixture_r001,
     "r002": fixture_r002,
@@ -546,6 +743,16 @@ FIXTURES: Dict[str, Callable[[], dict]] = {
     "sharded_prefill": fixture_sharded_prefill,
     "tp_decode": fixture_tp_decode,
     "draft_verify": fixture_draft_verify,
+    "h001": fixture_h001,
+    "h001_clean": fixture_h001_clean,
+    "h002": fixture_h002,
+    "h002_clean": fixture_h002_clean,
+    "h003": fixture_h003,
+    "h003_clean": fixture_h003_clean,
+    "h004": fixture_h004,
+    "h004_clean": fixture_h004_clean,
+    "h005": fixture_h005,
+    "h005_clean": fixture_h005_clean,
 }
 
 

@@ -266,7 +266,14 @@ class ReplicaRouter:
         uniform at-or-above this class (the arrival is rejected)."""
         best, best_key = None, None
         for rep in self.replicas.values():
-            if not (rep.alive and not rep.draining and rep.can_decode):
+            # rep.alive is a one-way flag: written False exactly once
+            # (under rep.lock, in fail_replica / remove_replica) and
+            # never resurrected, so the policy pump's bare reads race
+            # only benignly — a stale True admits one extra step that
+            # fail_replica then unwinds.  Lock-free by design; the
+            # monotonicity is pinned by tests/test_hostlint.py.
+            if not (rep.alive and not rep.draining  # hostlint: disable=H001
+                    and rep.can_decode):
                 continue
             if rep.frontend.queue_depth() < rep.frontend.max_queue:
                 continue  # not queue-bound: don't shed to jump pages

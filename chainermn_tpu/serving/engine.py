@@ -553,7 +553,10 @@ class InferenceEngine:
         self.params = jax.device_put(
             self.params, plan.shardings(mesh, self.params)
         )
-        self._cache = jax.device_put(
+        # Placement, not a replayed step: followers run _apply_plan
+        # themselves at attach (the plan is part of engine construction,
+        # not the mirrored op stream), so no mirror emit here.
+        self._cache = jax.device_put(  # hostlint: disable=H003
             self._cache, plan.shardings(mesh, self._cache)
         )
         if getattr(self, "draft_model", None) is not None:

@@ -20,8 +20,16 @@ Usage::
     # dict(fn=..., args=..., kwargs=..., comm=...):
     python -m chainermn_tpu.tools.lint --entry mypkg.train:lint_target
 
+    # host-plane rules (H001–H005) package-wide, against the committed
+    # wire-schema lockfile (exit 0 when clean):
+    python -m chainermn_tpu.tools.lint --host
+
+    # bless an intentional wire change into the lockfile:
+    python -m chainermn_tpu.tools.lint --host --regen-schemas
+
     # repo self-check: ruff (or the builtin AST fallback when ruff is
-    # not installed) over the package + examples, plus the clean gate:
+    # not installed) over the package + examples, the host-plane rules,
+    # plus the clean gate:
     python -m chainermn_tpu.tools.lint --self
 
 Exit status is nonzero iff any error-severity finding (or self-check
@@ -55,6 +63,22 @@ def _lint_one(target: dict, rules, disable) -> dict:
     from chainermn_tpu.analysis import analyze_fn, analyze_jaxpr, \
         analyze_plan
 
+    if "source" in target:  # host-plane source snippet (H-rule fixtures)
+        from chainermn_tpu.analysis import hostlint
+
+        hf = hostlint.make_host_file(
+            target.get("target", "<host>"), target["source"],
+            wire=target.get("wire", False), det=target.get("det", False),
+        )
+        report = hostlint.analyze_host(
+            [hf], rules=rules, disable=disable or (),
+            wire_lock=target.get("wire_lock"),
+        )
+        return {
+            "target": target.get("target", "<host>"),
+            "expect": target.get("expect"),
+            **report.summary(),
+        }
     if "audit" in target:  # pre-computed census (compiled-HLO fixtures)
         report = analyze_jaxpr(
             target["audit"], comm=target.get("comm"), rules=rules,
@@ -97,6 +121,25 @@ def _fixture_targets(names) -> list:
             f"unknown fixture(s) {unknown}; known: {sorted(FIXTURES)}"
         )
     return [FIXTURES[n]() for n in picks]
+
+
+def _wire_schemas_path() -> str:
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo_root, "tests", "golden", "wire_schemas.json")
+
+
+def _host_result(rules, disable) -> dict:
+    """Lint the host plane package-wide (H001–H005) against the
+    committed wire-schema lockfile."""
+    from chainermn_tpu.analysis import hostlint
+
+    report = hostlint.analyze_host(
+        hostlint.package_host_files(), rules=rules,
+        disable=disable or (),
+        wire_lock=hostlint.load_wire_lock(_wire_schemas_path()),
+    )
+    return {"target": "host", "expect": None, **report.summary()}
 
 
 def _entry_target(spec: str) -> dict:
@@ -246,9 +289,27 @@ def main(argv=None) -> int:
                          "returning dict(fn=, args=, kwargs=, comm=)")
     ap.add_argument("--self", dest="self_check", action="store_true",
                     help="source checks (ruff or builtin fallback) over "
-                         "the package + examples, plus the clean gate")
+                         "the package + examples, the host-plane rules, "
+                         "plus the clean gate")
+    ap.add_argument("--host", action="store_true",
+                    help="lint the host plane package-wide (H001–H005) "
+                         "against tests/golden/wire_schemas.json")
+    ap.add_argument("--regen-schemas", action="store_true",
+                    help="with --host: re-extract the wire structs and "
+                         "rewrite tests/golden/wire_schemas.json (the "
+                         "bless step after an intentional wire change)")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
+
+    if args.regen_schemas:
+        if not args.host:
+            ap.error("--regen-schemas requires --host")
+        from chainermn_tpu.analysis import hostlint
+
+        path = _wire_schemas_path()
+        data = hostlint.regen_wire_schemas(path)
+        print(f"wrote {path} ({len(data['schemas'])} wire schemas)")
+        return 0
 
     if args.list_rules:
         from chainermn_tpu.analysis import list_rules
@@ -276,13 +337,16 @@ def main(argv=None) -> int:
         targets.extend(_fixture_targets(args.fixtures))
     for spec in args.entry:
         targets.append(_entry_target(spec))
-    if not targets and args.fixtures is None and not args.entry:
+    if not targets and args.fixtures is None and not args.entry \
+            and not args.host:
         from chainermn_tpu.analysis.fixtures import CLEAN_COMMUNICATORS
 
         comms = _split_csv(args.communicators) or list(CLEAN_COMMUNICATORS)
         targets.extend(_clean_gate_targets(comms))
 
     results = [_lint_one(t, rules, disable) for t in targets]
+    if args.host or args.self_check:
+        results.append(_host_result(rules, disable))
     ok = all(r["ok"] for r in results) and not self_problems
 
     if args.format == "json":

@@ -36,6 +36,14 @@ def _analyze_fixture(t):
     from chainermn_tpu.analysis import analyze_fn, analyze_jaxpr, \
         analyze_plan
 
+    if "source" in t:  # host-plane snippets (H001–H005)
+        from chainermn_tpu.analysis import hostlint
+
+        hf = hostlint.make_host_file(
+            t["target"], t["source"],
+            wire=t.get("wire", False), det=t.get("det", False),
+        )
+        return hostlint.analyze_host([hf], wire_lock=t.get("wire_lock"))
     if "audit" in t:  # pre-computed census (e.g. compiled-HLO fixtures)
         return analyze_jaxpr(
             t["audit"], comm=t["comm"], n_leaves=t.get("n_leaves")
@@ -293,6 +301,7 @@ def test_cli_list_rules_json(capsys):
     assert lint_cli.main(["--list-rules", "--format", "json"]) == 0
     data = json.loads(capsys.readouterr().out)
     assert [r["id"] for r in data["rules"]] == [
+        "H001", "H002", "H003", "H004", "H005",
         "R001", "R002", "R003", "R004", "R005", "R006",
     ]
 

@@ -1,0 +1,373 @@
+"""Host-plane static analysis (H001–H005): seeded fixtures, the
+package-wide clean gate with its pinned suppression budget, lock-order
+cycle detection, the mirror-before-execute contract against a tampered
+engine clone, the wire-schema lockfile, and the CLI.
+
+The two in-tree suppressions are load-bearing and each has a targeted
+regression test here: stripping the ``# hostlint: disable`` comment
+must re-fire the rule, so a suppression can never outlive the code
+pattern it justifies.
+"""
+
+import copy
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WIRE_SCHEMAS_PATH = os.path.join(
+    REPO_ROOT, "tests", "golden", "wire_schemas.json"
+)
+
+#: every in-tree ``# hostlint: disable`` must carry a justifying
+#: comment; adding a third suppression means raising this knowingly.
+SUPPRESSION_BUDGET = 2
+
+
+def _analyze_package():
+    from chainermn_tpu.analysis import hostlint
+
+    return hostlint.analyze_host(
+        hostlint.package_host_files(),
+        wire_lock=hostlint.load_wire_lock(WIRE_SCHEMAS_PATH),
+    )
+
+
+def _flagged(report):
+    return sorted({f.rule for f in report.findings})
+
+
+# ----------------------------------------------------------------------
+# Seeded fixtures: every H-rule fires on its violating snippet and
+# stays silent on the clean twin
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("name", ["h001", "h002", "h003", "h004", "h005"])
+def test_seeded_host_fixture_flagged(name):
+    from chainermn_tpu.analysis import hostlint
+    from chainermn_tpu.analysis.fixtures import FIXTURES
+
+    def run(t):
+        hf = hostlint.make_host_file(
+            t["target"], t["source"],
+            wire=t.get("wire", False), det=t.get("det", False),
+        )
+        return hostlint.analyze_host([hf], wire_lock=t.get("wire_lock"))
+
+    t = FIXTURES[name]()
+    report = run(t)
+    assert t["expect"] in _flagged(report), report.render()
+    for f in report.findings:
+        assert f.message and f.fix_hint  # findings must be actionable
+
+    clean = FIXTURES[f"{name}_clean"]()
+    report = run(clean)
+    assert report.findings == [], report.render()
+
+
+# ----------------------------------------------------------------------
+# Package-wide clean gate + suppression budget
+# ----------------------------------------------------------------------
+def test_package_hostlint_clean_within_suppression_budget():
+    report = _analyze_package()
+    assert report.ok, report.render()
+    assert set(report.rules_run) == {
+        "H001", "H002", "H003", "H004", "H005",
+    }
+    assert 0 < report.suppressed <= SUPPRESSION_BUDGET, (
+        f"{report.suppressed} suppressions vs budget "
+        f"{SUPPRESSION_BUDGET} — every '# hostlint: disable' needs a "
+        f"justifying comment and a regression test in this file"
+    )
+
+
+def test_wire_lockfile_is_current():
+    """The committed lockfile must match what extraction produces from
+    the tree — a stale lockfile would let drift through unnoticed."""
+    from chainermn_tpu.analysis import hostlint
+
+    current = hostlint.extract_wire_schemas(hostlint.package_host_files())
+    stripped = {
+        k: {kk: vv for kk, vv in v.items() if kk != "loc"}
+        for k, v in current.items()
+    }
+    with open(WIRE_SCHEMAS_PATH) as fh:
+        lock = json.load(fh)
+    assert stripped == lock["schemas"], (
+        "regenerate with: python -m chainermn_tpu.tools.lint --host "
+        "--regen-schemas"
+    )
+    # the load-bearing structs are actually locked
+    for key in ("dataclass:ReplicaLoad", "dataclass:KVSnapshot",
+                "cmd:submit", "frame:tok", "meta:kv_snapshot"):
+        assert key in lock["schemas"], key
+
+
+# ----------------------------------------------------------------------
+# H001: lock-order cycle detection
+# ----------------------------------------------------------------------
+_CYCLE_SRC = '''\
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self.lock_a = threading.Lock()
+        self.lock_b = threading.Lock()
+
+    def forward(self):
+        with self.lock_a:
+            with self.lock_b:
+                pass
+
+    def backward(self):
+        with self.lock_b:
+            with self.lock_a:
+                pass
+'''
+
+
+def test_lock_order_cycle_detected():
+    from chainermn_tpu.analysis import hostlint
+
+    report = hostlint.analyze_host([("cycle.py", _CYCLE_SRC)])
+    cycles = [f for f in report.findings if "cycle" in f.message]
+    assert cycles, report.render()
+    assert cycles[0].rule == "H001"
+    assert "Pair.lock_a" in cycles[0].message
+    assert "Pair.lock_b" in cycles[0].message
+
+    # one consistent order: no cycle
+    consistent = _CYCLE_SRC.replace(
+        "        with self.lock_b:\n            with self.lock_a:",
+        "        with self.lock_a:\n            with self.lock_b:",
+    )
+    report = hostlint.analyze_host([("ordered.py", consistent)])
+    assert not [f for f in report.findings if "cycle" in f.message]
+
+
+# ----------------------------------------------------------------------
+# H003: negative test against a tampered clone of the REAL engine
+# ----------------------------------------------------------------------
+def _engine_source():
+    path = os.path.join(
+        REPO_ROOT, "chainermn_tpu", "serving", "engine.py"
+    )
+    with open(path, encoding="utf-8") as fh:
+        return fh.read()
+
+
+def test_h003_fires_on_mirror_stripped_engine_clone():
+    """Delete the decode path's mirror emit from a clone of the real
+    engine source: H003 must catch the regression the shard-group soak
+    used to be the only guard against."""
+    from chainermn_tpu.analysis import hostlint
+
+    src = _engine_source()
+    lines = src.splitlines(keepends=True)
+    stripped = [
+        ln for ln in lines
+        if not re.search(r'self\._mirror\(\s*"decode"', ln)
+    ]
+    assert len(stripped) < len(lines), "decode mirror emit not found"
+    report = hostlint.analyze_host([("engine_clone.py", "".join(stripped))])
+    hits = [f for f in report.findings if f.rule == "H003"]
+    assert any("_decode_step" in f.message for f in hits), report.render()
+
+
+def test_h003_suppression_in_apply_plan_is_load_bearing():
+    """_apply_plan's cache re-placement carries a justified suppression;
+    stripping the comment must re-fire H003 (and today's tree must need
+    exactly that one suppression in the engine)."""
+    from chainermn_tpu.analysis import hostlint
+
+    src = _engine_source()
+    assert "# hostlint: disable=H003" in src
+    bare = src.replace("  # hostlint: disable=H003", "")
+    report = hostlint.analyze_host([("engine_bare.py", bare)])
+    hits = [f for f in report.findings if f.rule == "H003"]
+    assert any("_apply_plan" in f.message for f in hits), report.render()
+    assert len(hits) == 1, report.render()
+
+
+# ----------------------------------------------------------------------
+# H001 suppression regression: rep.alive thread-confinement contract
+# ----------------------------------------------------------------------
+def _router_source():
+    path = os.path.join(
+        REPO_ROOT, "chainermn_tpu", "serving", "cluster", "router.py"
+    )
+    with open(path, encoding="utf-8") as fh:
+        return fh.read()
+
+
+def test_h001_alive_suppression_is_load_bearing():
+    from chainermn_tpu.analysis import hostlint
+
+    src = _router_source()
+    assert "# hostlint: disable=H001" in src
+    bare = src.replace("  # hostlint: disable=H001", "")
+    report = hostlint.analyze_host([("router_bare.py", bare)])
+    hits = [f for f in report.findings if f.rule == "H001"]
+    assert any("rep.alive" in f.message for f in hits), report.render()
+
+
+def test_alive_flag_is_one_way_in_router():
+    """The suppression's justification: ``alive`` may only ever be
+    written False by the router, so bare reads race benignly.  Anyone
+    resurrecting a replica in place invalidates the argument and must
+    revisit the locking."""
+    assert not re.search(r"\.alive\s*=\s*True", _router_source())
+
+
+# ----------------------------------------------------------------------
+# H004: tamper goldens — reorder and default-less append must fail
+# ----------------------------------------------------------------------
+def _current_and_lock():
+    from chainermn_tpu.analysis import hostlint
+
+    current = hostlint.extract_wire_schemas(hostlint.package_host_files())
+    with open(WIRE_SCHEMAS_PATH) as fh:
+        lock = json.load(fh)
+    return current, lock
+
+
+def test_h004_field_reorder_fails():
+    from chainermn_tpu.analysis import hostlint
+
+    current, lock = _current_and_lock()
+    tampered = copy.deepcopy(current)
+    fields = tampered["dataclass:ReplicaLoad"]["fields"]
+    fields[0], fields[1] = fields[1], fields[0]
+    findings = hostlint.compare_wire_schemas(tampered, lock)
+    assert any(
+        f.severity == "error" and "reordered" in f.message
+        and "ReplicaLoad" in f.message for f in findings
+    ), [f.render() for f in findings]
+
+
+def test_h004_defaultless_append_fails():
+    from chainermn_tpu.analysis import hostlint
+
+    current, lock = _current_and_lock()
+    tampered = copy.deepcopy(current)
+    tampered["dataclass:ReplicaLoad"]["fields"].append(["bogus", False])
+    findings = hostlint.compare_wire_schemas(tampered, lock)
+    assert any(
+        f.severity == "error" and "no default" in f.message
+        for f in findings
+    ), [f.render() for f in findings]
+
+
+def test_h004_defaulted_append_and_new_struct_pass():
+    """The sanctioned evolutions: a defaulted trailing field is silent;
+    a brand-new struct warns (bless via --regen-schemas) but does not
+    fail the gate."""
+    from chainermn_tpu.analysis import hostlint
+
+    current, lock = _current_and_lock()
+    grown = copy.deepcopy(current)
+    grown["dataclass:ReplicaLoad"]["fields"].append(["extra", True])
+    grown["cmd:brand_new"] = {"keys": ["op"], "loc": ("x.py", 1)}
+    findings = hostlint.compare_wire_schemas(grown, lock)
+    assert not [f for f in findings if f.severity == "error"], (
+        [f.render() for f in findings]
+    )
+    assert any("brand_new" in f.message for f in findings)
+
+
+def test_h004_struct_removal_fails():
+    from chainermn_tpu.analysis import hostlint
+
+    current, lock = _current_and_lock()
+    tampered = copy.deepcopy(current)
+    del tampered["frame:tok"]
+    findings = hostlint.compare_wire_schemas(tampered, lock)
+    assert any(
+        f.severity == "error" and "frame:tok" in f.message
+        for f in findings
+    )
+
+
+def test_regen_schemas_flow(tmp_path, monkeypatch):
+    """--host --regen-schemas rewrites the lockfile from the tree and
+    the result diffs clean against a fresh extraction."""
+    from chainermn_tpu.analysis import hostlint
+    from chainermn_tpu.tools import lint as lint_cli
+
+    target = tmp_path / "wire_schemas.json"
+    monkeypatch.setattr(
+        lint_cli, "_wire_schemas_path", lambda: str(target)
+    )
+    assert lint_cli.main(["--host", "--regen-schemas"]) == 0
+    regenerated = json.loads(target.read_text())
+    current = hostlint.extract_wire_schemas(hostlint.package_host_files())
+    findings = hostlint.compare_wire_schemas(current, regenerated)
+    assert findings == [], [f.render() for f in findings]
+
+
+# ----------------------------------------------------------------------
+# Suppression surfaces shared with the R-rules
+# ----------------------------------------------------------------------
+def test_line_scoped_suppression_counts():
+    from chainermn_tpu.analysis import hostlint
+    from chainermn_tpu.analysis.fixtures import _H001_BAD
+
+    suppressed_src = _H001_BAD.replace(
+        "        self.value = 0\n",
+        "        # single-threaded teardown path\n"
+        "        self.value = 0  # hostlint: disable=H001\n",
+    )
+    report = hostlint.analyze_host([("s.py", suppressed_src)])
+    assert report.ok and report.suppressed == 1
+
+
+def test_env_disable_applies_to_host_rules(monkeypatch):
+    from chainermn_tpu.analysis import ENV_DISABLE, hostlint
+    from chainermn_tpu.analysis.fixtures import _H001_BAD
+
+    monkeypatch.setenv(ENV_DISABLE, "H001")
+    report = hostlint.analyze_host([("s.py", _H001_BAD)])
+    assert report.ok and report.suppressed == 1
+
+
+# ----------------------------------------------------------------------
+# CLI
+# ----------------------------------------------------------------------
+def test_cli_host_in_process(capsys):
+    from chainermn_tpu.tools import lint as lint_cli
+
+    rc = lint_cli.main(["--host", "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0 and payload["ok"] is True
+    (host,) = [t for t in payload["targets"] if t["target"] == "host"]
+    assert host["suppressed"] == SUPPRESSION_BUDGET
+    assert host["rules_run"] == ["H001", "H002", "H003", "H004", "H005"]
+
+
+def test_cli_host_subprocess_smoke():
+    from conftest import subprocess_env
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "chainermn_tpu.tools.lint",
+         "--host", "--format", "json"],
+        capture_output=True, text=True, timeout=240,
+        env=subprocess_env(),
+    )
+    assert proc.returncode == 0, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True
+    assert [t["target"] for t in payload["targets"]] == ["host"]
+
+
+def test_cli_host_fixture_exits_nonzero(capsys):
+    from chainermn_tpu.tools import lint as lint_cli
+
+    rc = lint_cli.main(["--fixtures", "h003", "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1 and payload["ok"] is False
+    assert {f["rule"] for t in payload["targets"]
+            for f in t["findings"]} == {"H003"}
