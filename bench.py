@@ -1849,6 +1849,28 @@ def main(argv=None):
                          "section")
     ap.add_argument("--chaos-nproc", type=int, default=2,
                     help="world size for the --chaos soak")
+    ap.add_argument("--fabric-diurnal", action="store_true",
+                    help="resource-fabric soak: one chip ledger shared "
+                         "by an elastic training job (subprocess ranks) "
+                         "and an in-process serving fleet under diurnal "
+                         "traffic — the arbiter preempts trainer ranks "
+                         "at the peak (SIGTERM-grace-checkpoint path, "
+                         "serving backfill from the freed chips) and "
+                         "returns them in the trough (replica drained "
+                         "with zero dropped streams); reported against "
+                         "a no-arbiter baseline: tokens/s lost vs p99 "
+                         "defended, bit-exact training digest, ledger "
+                         "conservation; alone it is its own bench mode "
+                         "(additive JSON, default shape untouched)")
+    ap.add_argument("--fabric-traffic", default=None, metavar="SPEC",
+                    help="TrafficSpec for --fabric-diurnal (default: "
+                         "the fabric CLI's diurnal two-tenant spec)")
+    ap.add_argument("--fabric-nproc", type=int, default=2,
+                    help="initial trainer world for --fabric-diurnal")
+    ap.add_argument("--fabric-replicas", type=int, default=2,
+                    help="initial fleet size for --fabric-diurnal")
+    ap.add_argument("--fabric-steps", type=int, default=240,
+                    help="trainer steps for --fabric-diurnal")
     args = ap.parse_args(argv)
     if args.chaos and not args.serve and not args.serve_traffic \
             and args.only is None:
@@ -1866,6 +1888,11 @@ def main(argv=None):
         # no communicator, default JSON shape untouched.
         print(json.dumps(
             {"serve_long_context": _serve_long_context_bench(args)}))
+        return
+    if args.fabric_diurnal and not args.serve and args.only is None:
+        # Fabric-only mode: subprocess orchestration of both planes;
+        # no backend init here, default JSON shape untouched.
+        print(json.dumps({"fabric_diurnal": _fabric_diurnal_bench(args)}))
         return
     if not args.no_overlap:
         # Seed the latency-hiding / async-collective XLA flags before the
@@ -1972,6 +1999,107 @@ def _chaos_soak(args):
             chaos.get("params_digest")
             and chaos["params_digest"] == oracle.get("params_digest")
         )
+    return out
+
+
+def _fabric_diurnal_bench(args):
+    """``--fabric-diurnal``: the one-resource-fabric soak, twice.
+
+    Both runs replay the same diurnal traffic over the same fleet
+    geometry with the same elastic training job underneath; the
+    baseline pins the fleet and leaves training untouched
+    (``--no-arbiter``), the fabric run lets the arbiter trade chips.
+    The pinned evidence is the pair: what serving p99 the borrowed
+    chips defended at the peak versus what training tokens/s the loan
+    cost — plus the invariants (training digest bit-identical to the
+    uninterrupted baseline, zero dropped streams, ledger conserved,
+    burn rates back under 1 after the backfill, and at least one chip
+    round trip: preempt-for-serving AND return-to-training)."""
+    import subprocess
+    import sys
+    import tempfile
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    root = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+
+    def run(tag, *extra):
+        d = tempfile.mkdtemp(prefix=f"bench_fabric_{tag}_")
+        cmd = [
+            sys.executable, "-m", "chainermn_tpu.tools.fabric",
+            "--nproc", str(args.fabric_nproc),
+            "--replicas", str(args.fabric_replicas),
+            "--train-steps", str(args.fabric_steps),
+            "--workdir", d,
+            *extra,
+        ]
+        if args.fabric_traffic:
+            cmd += ["--traffic", args.fabric_traffic]
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=900,
+                env=env,
+            )
+        except Exception as e:  # pragma: no cover - environment-specific
+            return {"error": f"{type(e).__name__}: {e}"}
+        lines = [ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("FABRIC_REPORT ")]
+        if not lines:
+            return {
+                "error": (proc.stdout + proc.stderr).strip()[-800:]
+                or f"exit {proc.returncode}",
+            }
+        rep = json.loads(lines[-1].split(" ", 1)[1])
+        rep["exit_code"] = proc.returncode
+        return rep
+
+    baseline = run("baseline", "--no-arbiter")
+    fabric = run("fabric")
+    out = {
+        "nproc": args.fabric_nproc,
+        "replicas": args.fabric_replicas,
+        "baseline": baseline,
+        "fabric": fabric,
+    }
+    if "error" not in baseline and "error" not in fabric:
+        tr = fabric.get("transitions", {})
+        burn = max(fabric.get("burn_rates", {}).values(), default=0.0)
+        b_p99 = (baseline.get("serve") or {}).get("latency_p99_s")
+        f_p99 = (fabric.get("serve") or {}).get("latency_p99_s")
+        b_wall = (baseline.get("train") or {}).get("incarnations", 1)
+        f_wall = (fabric.get("train") or {}).get("incarnations", 1)
+        out["verdict"] = {
+            # the trade: what the borrowed chips cost training...
+            "train_extra_incarnations": f_wall - b_wall,
+            "train_lease_rescales":
+                (fabric.get("train") or {}).get("lease_rescales", 0),
+            # ...versus what they defended in serving tail latency.
+            "p99_baseline_s": b_p99,
+            "p99_fabric_s": f_p99,
+            "p99_defended": (
+                b_p99 is not None and f_p99 is not None
+                and f_p99 <= b_p99
+            ),
+            # invariants the fabric must not trade away:
+            "digest_match": bool(
+                (fabric.get("train") or {}).get("params_digest")
+                and (fabric["train"]["params_digest"]
+                     == (baseline.get("train") or {}).get("params_digest"))
+            ),
+            "preempted_for_serving": tr.get("preempt_for_serving", 0),
+            "returned_to_training": tr.get("return_to_training", 0),
+            "round_trip": (tr.get("preempt_for_serving", 0) >= 1
+                           and tr.get("return_to_training", 0) >= 1),
+            "dropped_streams": fabric.get("dropped_streams"),
+            "ledger_conserved": fabric.get("ledger_conserved"),
+            "parity": ("ok" if not fabric.get("parity", {}).get(
+                "mismatches") else "FAIL"),
+            "max_burn_rate": burn,
+            "slo_green": burn < 1.0,
+        }
     return out
 
 

@@ -82,6 +82,14 @@ HOST_PLANE_FILES: Tuple[Tuple[str, bool, bool], ...] = (
     ("serving/kv_cache.py", False, True),
     ("serving/frontend.py", False, True),
     ("serving/spec.py", False, True),
+    # Resource fabric: the chip ledger's lease frames and the heartbeat
+    # payload cross the supervisor/rank version boundary (wire scope);
+    # ledger/policy/arbiter decisions must be pure functions of their
+    # inputs (determinism scope).
+    ("elastic/heartbeat.py", True, False),
+    ("fabric/ledger.py", True, True),
+    ("fabric/policy.py", False, True),
+    ("fabric/arbiter.py", False, True),
 )
 
 

@@ -17,9 +17,11 @@ from chainermn_tpu.elastic.chaos import (  # noqa: F401
     Fault,
 )
 from chainermn_tpu.elastic.heartbeat import (  # noqa: F401
+    BeatInfo,
     FileBeat,
     HeartbeatMonitor,
     read_beat,
+    read_beat_info,
 )
 from chainermn_tpu.elastic.supervisor import (  # noqa: F401
     EXIT_PREEMPTED,
@@ -37,9 +39,11 @@ __all__ = [
     "ChaosEngine",
     "ChaosSchedule",
     "Fault",
+    "BeatInfo",
     "FileBeat",
     "HeartbeatMonitor",
     "read_beat",
+    "read_beat_info",
     "EXIT_PREEMPTED",
     "ElasticSupervisor",
     "SupervisorConfig",

@@ -17,9 +17,28 @@ supervisor's restart path must not re-fire).
 
 from __future__ import annotations
 
+import dataclasses
+import json
 import os
 import time
 from typing import Callable, Dict, Iterable, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class BeatInfo:
+    """Decoded contents of a beat file.
+
+    ``mtime`` IS the liveness signal (what :func:`read_beat` returns);
+    the payload fields are diagnostics.  The fabric fields (``plane``,
+    ``lease_id``, ``world``) are trailing-defaulted so pre-fabric beat
+    files — a bare step number, possibly empty — keep decoding: wire
+    compatibility across the supervisor/rank version boundary."""
+
+    mtime: float
+    step: int = -1
+    plane: str = ""
+    lease_id: str = ""
+    world: int = 0
 
 
 class HeartbeatMonitor:
@@ -80,10 +99,16 @@ class FileBeat:
     postmortems); chaos's delayed-heartbeat fault suppresses beats via
     :meth:`suppress` without touching the training loop."""
 
-    def __init__(self, path: str, clock: Callable[[], float] = time.time):
+    def __init__(self, path: str, clock: Callable[[], float] = time.time,
+                 plane: str = "", lease_id: str = "", world: int = 0):
         self.path = str(path)
         self._clock = clock
         self._suppress_until = 0.0
+        #: fabric identity stamped into each beat (who holds this
+        #: chip); empty means pre-fabric legacy format.
+        self.plane = str(plane)
+        self.lease_id = str(lease_id)
+        self.world = int(world)
         d = os.path.dirname(os.path.abspath(self.path))
         os.makedirs(d, exist_ok=True)
 
@@ -97,7 +122,17 @@ class FileBeat:
             return
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
-            f.write("" if step is None else str(int(step)))
+            if not (self.plane or self.lease_id or self.world):
+                # Legacy format: bare step number (or empty).  Readers
+                # of old supervisors only ever stat the mtime.
+                f.write("" if step is None else str(int(step)))
+            else:
+                f.write(json.dumps({
+                    "step": -1 if step is None else int(step),
+                    "plane": self.plane,
+                    "lease": self.lease_id,
+                    "world": self.world,
+                }, sort_keys=True))
         os.replace(tmp, self.path)  # atomic: readers never see a torn file
 
 
@@ -109,3 +144,35 @@ def read_beat(path: str) -> Optional[float]:
         return os.stat(path).st_mtime
     except OSError:
         return None
+
+
+def read_beat_info(path: str) -> Optional[BeatInfo]:
+    """Decode a beat file into a :class:`BeatInfo` — parses both the
+    legacy bare-step format and the fabric JSON payload, so a new
+    supervisor reads old ranks' beats and vice versa."""
+    mtime = read_beat(path)
+    if mtime is None:
+        return None
+    try:
+        with open(path) as f:
+            raw = f.read().strip()
+    except OSError:
+        return None
+    if not raw:
+        return BeatInfo(mtime=mtime)
+    if raw.startswith("{"):
+        try:
+            d = json.loads(raw)
+        except ValueError:
+            return BeatInfo(mtime=mtime)
+        return BeatInfo(
+            mtime=mtime,
+            step=int(d.get("step", -1)),
+            plane=str(d.get("plane", "")),
+            lease_id=str(d.get("lease", "")),
+            world=int(d.get("world", 0)),
+        )
+    try:
+        return BeatInfo(mtime=mtime, step=int(raw))
+    except ValueError:
+        return BeatInfo(mtime=mtime)

@@ -127,6 +127,20 @@ class ElasticSupervisor:
         self.restarts = 0
         self.preemptions = 0
         self.incarnation = 0
+        #: fabric control surface: a chip arbiter asks the job to
+        #: change size via :meth:`yield_ranks`/:meth:`grant_ranks`.
+        #: The resize rides the normal preemption path (SIGTERM →
+        #: grace-window checkpoint → exit 75 → respawn), so resumes
+        #: stay bit-exact; lease-driven rescales are counted separately
+        #: and never burn the ``max_preemptions`` budget.
+        self.world = config.nproc
+        self.running = False
+        self.lease_rescales = 0
+        self.lease_tag = ""
+        self._ctl_lock = threading.Lock()
+        self._target_world: Optional[int] = None
+        self._fabric_preempt = False
+        self._live_ranks: List[_Rank] = []
         self.resume_generation: Optional[int] = None
         self.params_digest: Optional[str] = None
         self.events: List[dict] = []
@@ -161,6 +175,50 @@ class ElasticSupervisor:
             self._reporter.gauge("elastic/resume_generation",
                                  self.resume_generation or 0)
 
+    # -- fabric control surface ----------------------------------------
+    def set_lease_tag(self, tag: str) -> None:
+        """Stamp subsequent incarnations with the fabric lease id (the
+        ranks echo it into their heartbeat files)."""
+        self.lease_tag = tag
+
+    def request_world(self, new_world: int) -> bool:
+        """Ask the running job to resize to ``new_world`` ranks.
+
+        Returns immediately (False when the job is not running or the
+        size is a no-op); the resize completes asynchronously: live
+        ranks get SIGTERM, take the grace-window checkpoint, exit 75,
+        and the run loop respawns at the new size, where ``maybe_load``
+        re-shards through the ShardingPlan registry and resumes
+        bit-exactly.  Watch :attr:`world` to observe completion.
+        """
+        new_world = max(int(new_world), self.config.min_nproc)
+        with self._ctl_lock:
+            if not self.running:
+                return False
+            if new_world == (self._target_world
+                             if self._target_world is not None
+                             else self.world):
+                return False
+            self._target_world = new_world
+            self._fabric_preempt = True
+            live = list(self._live_ranks)
+        for rk in live:
+            if rk.proc.poll() is None:
+                try:
+                    rk.proc.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        return True
+
+    def yield_ranks(self, k: int) -> bool:
+        """Shrink the job by ``k`` ranks (fabric preempts chips for
+        serving)."""
+        return self.request_world(self.world - int(k))
+
+    def grant_ranks(self, k: int) -> bool:
+        """Grow the job by ``k`` ranks (fabric returns chips)."""
+        return self.request_world(self.world + int(k))
+
     # -- process plumbing ----------------------------------------------
     def _free_port(self) -> int:
         with socket.socket() as s:
@@ -190,6 +248,8 @@ class ElasticSupervisor:
                     str(cfg.init_timeout_s),
                 "CHAINERMN_TPU_POSTMORTEM_FILE":
                     os.path.join(self._workdir, "postmortem.jsonl"),
+                "CHAINERMN_TPU_ELASTIC_PLANE": "train",
+                "CHAINERMN_TPU_ELASTIC_LEASE": self.lease_tag,
             })
             if cfg.chaos:
                 env["CHAINERMN_TPU_CHAOS"] = cfg.chaos
@@ -201,6 +261,9 @@ class ElasticSupervisor:
                 stderr=subprocess.STDOUT, text=True, env=env,
             )
             ranks.append(_Rank(r, proc, hb, cfg.echo))
+        with self._ctl_lock:
+            self._live_ranks = ranks
+            self.world = world
         self._event("spawn", world=world, coordinator=coord,
                     pids=[rk.proc.pid for rk in ranks])
         return ranks
@@ -295,6 +358,20 @@ class ElasticSupervisor:
                 preempted = any(
                     rk.proc.poll() == EXIT_PREEMPTED for rk in ranks
                 )
+                if not preempted:
+                    # A fabric resize SIGTERMs every rank; one that dies
+                    # to the signal before its grace handler is up exits
+                    # -SIGTERM.  When a resize is pending and every exit
+                    # is explained by it (clean, checkpointed, or killed
+                    # by our own signal), the wave is the resize — it
+                    # must ride the lease budget, not the crash budget.
+                    with self._ctl_lock:
+                        fabric_pending = self._fabric_preempt
+                    preempted = fabric_pending and not hb_dead and all(
+                        rk.proc.poll()
+                        in (None, 0, EXIT_PREEMPTED, -signal.SIGTERM)
+                        for rk in ranks
+                    )
                 self._event(
                     "failure", exited=exited_bad, heartbeat_dead=hb_dead,
                     preempted=preempted,
@@ -355,8 +432,19 @@ class ElasticSupervisor:
             )
             self._exporter.start()
             self.metrics_url = self._exporter.url
+        self.running = True
         try:
             while True:
+                # Consume a pending fabric resize before (re)spawning:
+                # request_world may have landed during the previous
+                # incarnation's teardown or the backoff window.
+                with self._ctl_lock:
+                    target = self._target_world
+                    self._target_world = None
+                if target is not None and target != world:
+                    self._event("lease_rescale", from_world=world,
+                                to_world=target)
+                    world = target
                 ranks = self._spawn_world(world)
                 result = self._monitor(ranks)
                 last_codes = {
@@ -367,11 +455,22 @@ class ElasticSupervisor:
                     self._event("success", world=world, codes=last_codes)
                     break
                 if result["outcome"] == "preempted":
-                    self.preemptions += 1
-                    self._event("preempted", codes=last_codes)
-                    if self.preemptions > cfg.max_preemptions:
-                        self._event("give_up", reason="max_preemptions")
-                        break
+                    with self._ctl_lock:
+                        fabric = self._fabric_preempt
+                        self._fabric_preempt = False
+                    if fabric:
+                        # Arbiter-initiated resize: same checkpoint
+                        # exit, but routine by design — it must never
+                        # exhaust the preemption budget.
+                        self.lease_rescales += 1
+                        self._event("lease_preempt", codes=last_codes)
+                    else:
+                        self.preemptions += 1
+                        self._event("preempted", codes=last_codes)
+                        if self.preemptions > cfg.max_preemptions:
+                            self._event("give_up",
+                                        reason="max_preemptions")
+                            break
                 else:
                     self.restarts += 1
                     if self.restarts > cfg.max_restarts:
@@ -392,6 +491,7 @@ class ElasticSupervisor:
                     cfg.backoff_s * (2 ** max(0, self.restarts - 1)), 8.0
                 ))
         finally:
+            self.running = False
             report = {
                 "status": status,
                 "nproc": cfg.nproc,
@@ -399,6 +499,7 @@ class ElasticSupervisor:
                 "incarnations": self.incarnation + 1,
                 "restarts": self.restarts,
                 "preemptions": self.preemptions,
+                "lease_rescales": self.lease_rescales,
                 "resume_generation": self.resume_generation,
                 "params_digest": self.params_digest,
                 "exit_codes": last_codes,

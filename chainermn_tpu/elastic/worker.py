@@ -172,10 +172,29 @@ def init_from_env(install_hooks: bool = True) -> Optional[ElasticContext]:
         os.environ.get("CHAINERMN_TPU_ELASTIC_INIT_TIMEOUT_S", "120")
     )
 
+    early_term = {"fired": False}
+    if install_hooks:
+        # A fabric resize can SIGTERM this rank between exec and the
+        # real handler below (jax.distributed clobbers SIGTERM during
+        # init, so the real handler can only go in afterwards).  Record
+        # instead of dying so the early window doesn't turn a lease
+        # rescale into a -SIGTERM crash.
+        signal.signal(
+            signal.SIGTERM,
+            lambda signum, frame: early_term.__setitem__("fired", True),
+        )
+
     hb = None
     hb_path = os.environ.get("CHAINERMN_TPU_ELASTIC_HB_FILE")
     if hb_path:
-        hb = FileBeat(hb_path)
+        # Fabric identity (which plane/lease this chip serves) rides
+        # the beat payload; absent env vars keep the legacy format.
+        hb = FileBeat(
+            hb_path,
+            plane=os.environ.get("CHAINERMN_TPU_ELASTIC_PLANE", ""),
+            lease_id=os.environ.get("CHAINERMN_TPU_ELASTIC_LEASE", ""),
+            world=nproc,
+        )
     engine = chaos_mod.engine_from_env(rank, incarnation, heartbeat=hb)
     ctx = ElasticContext(rank, nproc, coord, incarnation, hb, engine)
 
@@ -198,6 +217,8 @@ def init_from_env(install_hooks: bool = True) -> Optional[ElasticContext]:
         # SIGTERM handler there, which would otherwise clobber ours and
         # turn every preemption into an uncoordinated shutdown.
         signal.signal(signal.SIGTERM, on_term)
+        if early_term["fired"]:
+            ctx._preempted = True
     if hb is not None:
         hb.beat(-1)  # prove liveness before the first training step
     return ctx

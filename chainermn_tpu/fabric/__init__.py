@@ -1,0 +1,38 @@
+"""One resource fabric: training and serving trade TPUs under SLO
+pressure.
+
+The fabric sits ABOVE the two planes this repo already grew — the
+elastic training supervisor (``chainermn_tpu.elastic``) and the
+SLO-guarded serving fleet (``chainermn_tpu.serving.cluster``) — and
+brokers chips between them:
+
+* :class:`~chainermn_tpu.fabric.ledger.ChipLedger` — the single source
+  of truth for who holds which chips.  Conservation
+  (``granted + free == total``) is checked at every event.
+* :class:`~chainermn_tpu.fabric.policy.FabricPolicy` — when to move
+  chips: debounced serving-pressure votes (reusing the autoscaler's
+  ``ScaleSignalFilter`` hysteresis) against per-plane floors/ceilings.
+* :class:`~chainermn_tpu.fabric.arbiter.FabricArbiter` — the actuator:
+  preempts trainer ranks through the EXISTING SIGTERM-grace-checkpoint
+  path and hands the freed chips to the autoscaler as backfill
+  replicas; on traffic troughs it drains replicas (drain → migrate →
+  retire, zero dropped streams) and returns the chips to training.
+
+Drive both planes in one process tree with
+``python -m chainermn_tpu.tools.fabric``; methodology and the lease
+lifecycle are in ``docs/fabric.md``.
+"""
+
+from chainermn_tpu.fabric.arbiter import FabricArbiter, TrainerHandle
+from chainermn_tpu.fabric.ledger import ChipLedger, Lease, LedgerError
+from chainermn_tpu.fabric.policy import FabricPolicy, FabricPolicyConfig
+
+__all__ = [
+    "ChipLedger",
+    "FabricArbiter",
+    "FabricPolicy",
+    "FabricPolicyConfig",
+    "Lease",
+    "LedgerError",
+    "TrainerHandle",
+]

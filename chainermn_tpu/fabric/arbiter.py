@@ -1,0 +1,355 @@
+"""Fabric arbiter: the actuator that moves chips between planes.
+
+The arbiter owns a :class:`~chainermn_tpu.fabric.ledger.ChipLedger`
+and drives the two planes through surfaces they already expose:
+
+* **training** — a trainer handle (the elastic supervisor, or any
+  duck-typed stand-in) with ``world``/``active`` and
+  ``yield_ranks``/``grant_ranks``.  Shrinking rides the EXISTING
+  preemption path end-to-end: the supervisor SIGTERMs live ranks, each
+  worker's ``check_preemption`` agrees host-plane, saves a blocking
+  checkpoint, and exits 75; the supervisor classifies the wave as a
+  preemption (never against ``max_restarts``) and respawns at the new
+  world size, where ``maybe_load`` resumes bit-exactly.
+* **serving** — the :class:`~chainermn_tpu.serving.cluster.autoscaler.
+  Autoscaler`'s granted-capacity surface (``grant_capacity`` /
+  ``yield_capacity`` / ``on_retire``) plus ``force_drain`` for the
+  graceful drain → migrate → retire sequence that drops zero streams.
+
+Transitions are asynchronous — a preemption takes a full
+checkpoint/respawn round-trip — so the arbiter runs one transition at
+a time as a small pending-state machine, re-cutting ledger leases only
+when the plane has actually reached its target shape.  Chips are
+therefore never double-counted: they stay on the old lease until the
+old holder is provably gone.
+
+Dead replicas are reconciled before anything else each step: a leased
+replica that vanished (SIGKILL) hands its lease to the autoscaler's
+backfill twin if one is up, else the chips return to the free pool.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from chainermn_tpu.fabric.ledger import ChipLedger
+from chainermn_tpu.fabric.policy import FabricPolicy, FabricPolicyConfig
+from chainermn_tpu.serving.cluster.health import scale_signals
+
+
+class TrainerHandle:
+    """Adapter giving the arbiter its duck-typed view of a training
+    plane: ``world`` (current rank count), ``active`` (still running),
+    ``yield_ranks(k)`` / ``grant_ranks(k)``.  Wraps an
+    ``ElasticSupervisor``; tests pass any object with the same four
+    names directly instead."""
+
+    def __init__(self, supervisor):
+        self._sup = supervisor
+
+    @property
+    def world(self) -> int:
+        return self._sup.world
+
+    @property
+    def active(self) -> bool:
+        return bool(self._sup.running)
+
+    def yield_ranks(self, k: int) -> bool:
+        return self._sup.yield_ranks(k)
+
+    def grant_ranks(self, k: int) -> bool:
+        return self._sup.grant_ranks(k)
+
+
+class FabricArbiter:
+    """One control loop brokering chips between training and serving.
+
+    Call :meth:`bootstrap` once after both planes are up, then
+    :meth:`step` from the same pump that steps the router and the
+    autoscaler.  Decisions land in :attr:`events`; transition counts in
+    :attr:`transitions`; gauges under ``fabric/*``.
+    """
+
+    def __init__(
+        self,
+        ledger: ChipLedger,
+        trainer,
+        autoscaler,
+        policy: Optional[FabricPolicy] = None,
+        reporter=None,
+        anomaly=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.ledger = ledger
+        self.trainer = trainer
+        self.autoscaler = autoscaler
+        self.router = autoscaler.router
+        self.policy = policy or FabricPolicy(FabricPolicyConfig(),
+                                             clock=clock)
+        self.reporter = reporter
+        self.anomaly = anomaly
+        self.clock = clock
+        self._train_lease: Optional[str] = None
+        self._replica_leases: Dict[Any, str] = {}
+        self._pending: Optional[Dict[str, Any]] = None
+        self.events: List[dict] = []
+        self.transitions = {
+            "grant_free": 0,
+            "preempt_for_serving": 0,
+            "return_to_training": 0,
+        }
+
+    # -- wiring --------------------------------------------------------
+
+    def bootstrap(self) -> None:
+        """Grant the initial leases covering the planes as they stand
+        and take over the autoscaler's growth ceiling."""
+        cfg = self.policy.config
+        if self.trainer.active and self.trainer.world > 0:
+            lease = self.ledger.grant(
+                "train", self.trainer.world * cfg.chips_per_rank,
+                reason="bootstrap",
+            )
+            self._train_lease = lease.lease_id
+        alive = [
+            rid for rid in sorted(self.router.replicas, key=repr)
+            if self.router.replicas[rid].alive
+        ]
+        for rid in alive:
+            lease = self.ledger.grant(
+                "serve", cfg.chips_per_replica,
+                reason="bootstrap:%s" % rid,
+            )
+            self._replica_leases[rid] = lease.lease_id
+        self.autoscaler.set_capacity(len(alive))
+        self.autoscaler.on_retire = self._note_retire
+        self._event("bootstrap", self.clock(),
+                    train_ranks=self.trainer.world, replicas=len(alive))
+
+    def _note_retire(self, rid) -> None:
+        """Autoscaler callback: a drained replica fully retired — its
+        chips go back to the free pool and the ceiling drops."""
+        lease_id = self._replica_leases.pop(rid, None)
+        if lease_id is not None:
+            self.ledger.release(lease_id, reason="retire:%s" % rid)
+        self.autoscaler.yield_capacity(1)
+
+    # -- bookkeeping ---------------------------------------------------
+
+    def _event(self, action: str, now: float, **extra) -> dict:
+        ev = {"action": action, "t": now, **extra}
+        self.events.append(ev)
+        if self.reporter is not None:
+            self.reporter.count("fabric/%s" % action, 1)
+        return ev
+
+    def _alive_replicas(self) -> List[Any]:
+        return [
+            rid for rid in sorted(self.router.replicas, key=repr)
+            if self.router.replicas[rid].alive
+        ]
+
+    def _reconcile_dead(self, now: float) -> None:
+        """A leased replica that vanished (chaos SIGKILL) must not
+        strand chips.  Prefer moving the lease onto an unleased alive
+        replica — the autoscaler's emergency backfill twin — so custody
+        follows capacity; otherwise the chips return to free and the
+        ceiling drops."""
+        alive = self._alive_replicas()
+        unleased = [r for r in alive if r not in self._replica_leases]
+        for rid in sorted(self._replica_leases, key=repr):
+            if rid in alive:
+                continue
+            lease_id = self._replica_leases.pop(rid)
+            if unleased:
+                twin = unleased.pop(0)
+                self._replica_leases[twin] = lease_id
+                self._event("lease_transfer", now,
+                            lease=lease_id, dead=rid, to=twin)
+            else:
+                self.ledger.release(lease_id,
+                                    reason="replica_dead:%s" % rid)
+                self.autoscaler.yield_capacity(1)
+                self._event("lease_reclaim", now,
+                            lease=lease_id, dead=rid)
+
+    def _recut_train_lease(self, reason: str) -> None:
+        """Re-issue the training lease at the trainer's current world
+        size (or release it entirely when training finished)."""
+        cfg = self.policy.config
+        if self._train_lease is not None:
+            self.ledger.release(self._train_lease, reason=reason)
+            self._train_lease = None
+        if self.trainer.active and self.trainer.world > 0:
+            lease = self.ledger.grant(
+                "train", self.trainer.world * cfg.chips_per_rank,
+                reason=reason,
+            )
+            self._train_lease = lease.lease_id
+
+    def _grant_serve_replicas(self, n: int, now: float,
+                              reason: str) -> List[Any]:
+        cfg = self.policy.config
+        n = min(int(n), self.ledger.free // max(1, cfg.chips_per_replica))
+        if n <= 0:
+            return []
+        rids = self.autoscaler.grant_capacity(n, now=now, reason=reason)
+        for rid in rids:
+            lease = self.ledger.grant(
+                "serve", cfg.chips_per_replica,
+                reason="%s:%s" % (reason, rid),
+            )
+            self._replica_leases[rid] = lease.lease_id
+        return rids
+
+    # -- control loop --------------------------------------------------
+
+    def step(self, now: Optional[float] = None) -> Optional[dict]:
+        """One arbitration iteration; returns the event emitted this
+        call (None when both planes are left alone)."""
+        now = self.clock() if now is None else now
+        self._reconcile_dead(now)
+
+        # Training finished on its own: its lease becomes free pool.
+        if (not self.trainer.active and self._train_lease is not None
+                and self._pending is None):
+            self.ledger.release(self._train_lease, reason="train_done")
+            self._train_lease = None
+            self._event("train_done", now)
+
+        self._publish_gauges()
+
+        if self._pending is not None:
+            return self._progress_pending(now)
+        return self._observe_and_decide(now)
+
+    def _publish_gauges(self) -> None:
+        if self.reporter is None:
+            return
+        self.reporter.gauge("fabric/free_chips", self.ledger.free)
+        self.reporter.gauge("fabric/train_chips",
+                            self.ledger.held("train"))
+        self.reporter.gauge("fabric/serve_chips",
+                            self.ledger.held("serve"))
+        self.reporter.gauge("fabric/pending",
+                            int(self._pending is not None))
+
+    def _progress_pending(self, now: float) -> Optional[dict]:
+        p = self._pending
+        assert p is not None
+        if p["action"] == "preempt_for_serving":
+            # Wait for the supervisor to respawn at the shrunk world —
+            # chips stay on the old training lease until the old ranks
+            # are provably gone (checkpointed + exited 75).
+            if self.trainer.active and self.trainer.world != p["target_world"]:
+                return None
+            self._recut_train_lease("preempt_for_serving")
+            rids = self._grant_serve_replicas(
+                p["replicas"], now, reason="backfill")
+            self._pending = None
+            self.transitions["preempt_for_serving"] += 1
+            return self._event(
+                "preempt_for_serving_done", now,
+                train_ranks=self.trainer.world,
+                backfill=list(rids),
+            )
+        if p["action"] == "return_to_training":
+            rid = p["replica"]
+            if p["stage"] == "drain":
+                if rid in self._replica_leases:
+                    return None  # still draining/migrating; retire pends
+                # Retire (or death-reconcile) returned the chips; now
+                # grow training with them.
+                cfg = self.policy.config
+                k = p["ranks"]
+                if (not self.trainer.active
+                        or self.ledger.free < k * cfg.chips_per_rank
+                        or not self.trainer.grant_ranks(k)):
+                    self._pending = None
+                    return self._event("return_abandoned", now,
+                                       replica=rid)
+                p["stage"] = "regrow"
+                p["target_world"] = self.trainer.world + k
+                return self._event("regrow_start", now,
+                                   target_world=p["target_world"])
+            # stage == "regrow": wait for the respawn at the grown
+            # world, then move the chips onto the training lease.
+            if self.trainer.active and self.trainer.world != p["target_world"]:
+                return None
+            self._recut_train_lease("return_to_training")
+            self._pending = None
+            self.transitions["return_to_training"] += 1
+            return self._event("return_to_training_done", now,
+                               train_ranks=self.trainer.world)
+        raise AssertionError("unknown pending action %r" % p["action"])
+
+    def _observe_and_decide(self, now: float) -> Optional[dict]:
+        c = self.autoscaler.config
+        signals = scale_signals(
+            self.router.loads(now),
+            low_free_frac=c.low_free_frac,
+            high_free_frac=c.high_free_frac,
+            queue_pressure_frac=c.queue_pressure_frac,
+        )
+        burn = self.autoscaler._max_burn_rate()
+        anomalous = self.anomaly is not None and self.anomaly.alarming()
+        action = self.policy.decide(
+            signals=signals,
+            burn=burn,
+            anomalous=anomalous,
+            train_ranks=self.trainer.world if self.trainer.active else 0,
+            serve_replicas=len(self._alive_replicas()),
+            free_chips=self.ledger.free,
+            train_active=self.trainer.active,
+            now=now,
+        )
+        if action is None:
+            return None
+        if action["action"] == "grant_free":
+            rids = self._grant_serve_replicas(
+                action["replicas"], now, reason="fabric_free")
+            if not rids:
+                return None
+            self.transitions["grant_free"] += 1
+            return self._event("grant_free", now,
+                               backfill=list(rids))
+        if action["action"] == "preempt_for_serving":
+            k = action["ranks"]
+            target = self.trainer.world - k
+            if not self.trainer.yield_ranks(k):
+                return None
+            cfg = self.policy.config
+            self._pending = {
+                "action": "preempt_for_serving",
+                "target_world": target,
+                "replicas": max(1,
+                                (k * cfg.chips_per_rank)
+                                // max(1, cfg.chips_per_replica)),
+            }
+            return self._event("preempt_start", now, ranks=k,
+                               target_world=target)
+        if action["action"] == "return_to_training":
+            rid = action["replica"]
+            if not self.autoscaler.force_drain(rid, now=now):
+                return None
+            self._pending = {
+                "action": "return_to_training",
+                "replica": rid,
+                "ranks": action["ranks"],
+                "stage": "drain",
+            }
+            return self._event("drain_start", now, replica=rid,
+                               ranks=action["ranks"])
+        raise AssertionError("unknown action %r" % action["action"])
+
+    # -- reporting -----------------------------------------------------
+
+    def as_report(self) -> Dict[str, Any]:
+        return {
+            "transitions": dict(self.transitions),
+            "events": list(self.events),
+            "pending": dict(self._pending) if self._pending else None,
+            "ledger": self.ledger.as_report(),
+        }

@@ -1,0 +1,210 @@
+"""Chip ledger: the fabric's single source of truth for chip custody.
+
+Every chip in the fabric is at all times either *free* or covered by
+exactly one :class:`Lease` held by a plane (``"train"`` or
+``"serve"``).  The ledger enforces conservation —
+
+    ``granted + free == total``
+
+— after every mutation, and records every grant/yield as a wire frame
+so the invariant can be audited post-hoc (:meth:`ChipLedger.conserved`)
+and asserted by the multi-process soak even when the arbiter crashed
+mid-transition.
+
+The ledger is deliberately passive: it never decides anything and never
+talks to the planes.  The arbiter (``fabric/arbiter.py``) is the only
+writer.  No wall-clock or RNG enters this file — event ordering is a
+monotonically increasing sequence number, which keeps replays
+deterministic (H005).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class LedgerError(RuntimeError):
+    """Raised when an operation would violate chip conservation."""
+
+
+@dataclass(frozen=True)
+class Lease:
+    """An exclusive claim on ``chips`` chips by one plane.
+
+    Trailing fields are defaulted so older readers of the wire frame
+    keep decoding newer grants (same wire-compat rule as
+    ``ReplicaLoad``).
+    """
+
+    lease_id: str
+    plane: str
+    chips: int
+    reason: str = ""
+    granted_seq: int = 0
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "lease_id": self.lease_id,
+            "plane": self.plane,
+            "chips": self.chips,
+            "reason": self.reason,
+            "granted_seq": self.granted_seq,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Lease":
+        return Lease(
+            lease_id=str(d["lease_id"]),
+            plane=str(d["plane"]),
+            chips=int(d["chips"]),
+            reason=str(d.get("reason", "")),
+            granted_seq=int(d.get("granted_seq", 0)),
+        )
+
+
+class ChipLedger:
+    """Tracks chip custody with conservation checked at every event.
+
+    ``free`` is tracked explicitly (not derived) so that
+    ``granted + free == total`` is a real invariant that a bug in
+    either bookkeeping path would break loudly, rather than a
+    tautology.
+    """
+
+    def __init__(self, total_chips: int):
+        if total_chips <= 0:
+            raise ValueError("total_chips must be positive")
+        self._total = int(total_chips)
+        self._free = int(total_chips)
+        self._leases: Dict[str, Lease] = {}
+        self._seq = 0
+        self._events: List[Dict[str, Any]] = []
+        self._check("init")
+
+    # -- read surface -------------------------------------------------
+
+    @property
+    def total(self) -> int:
+        return self._total
+
+    @property
+    def free(self) -> int:
+        return self._free
+
+    @property
+    def granted(self) -> int:
+        return sum(l.chips for l in self._leases.values())
+
+    def held(self, plane: str) -> int:
+        """Chips currently leased to ``plane``."""
+        return sum(l.chips for l in self._leases.values() if l.plane == plane)
+
+    def leases(self, plane: Optional[str] = None) -> Tuple[Lease, ...]:
+        out = [
+            self._leases[k]
+            for k in sorted(self._leases)
+            if plane is None or self._leases[k].plane == plane
+        ]
+        return tuple(out)
+
+    def get(self, lease_id: str) -> Optional[Lease]:
+        return self._leases.get(lease_id)
+
+    @property
+    def events(self) -> List[Dict[str, Any]]:
+        return list(self._events)
+
+    # -- mutation -----------------------------------------------------
+
+    def grant(self, plane: str, chips: int, reason: str = "") -> Lease:
+        """Move ``chips`` chips from the free pool to a new lease."""
+        chips = int(chips)
+        if chips <= 0:
+            raise LedgerError("grant of %d chips (must be positive)" % chips)
+        if chips > self._free:
+            raise LedgerError(
+                "grant of %d chips to %r exceeds free pool (%d free of %d)"
+                % (chips, plane, self._free, self._total)
+            )
+        self._seq += 1
+        lease = Lease(
+            lease_id="ls%d" % self._seq,
+            plane=plane,
+            chips=chips,
+            reason=reason,
+            granted_seq=self._seq,
+        )
+        self._free -= chips
+        self._leases[lease.lease_id] = lease
+        frame = {
+            "op": "lease_grant",
+            "seq": self._seq,
+            "lease": lease.lease_id,
+            "plane": plane,
+            "chips": chips,
+            "reason": reason,
+            "granted": self.granted,
+            "free": self._free,
+            "total": self._total,
+        }
+        self._events.append(frame)
+        self._check("grant %s" % lease.lease_id)
+        return lease
+
+    def release(self, lease_id: str, reason: str = "") -> Lease:
+        """Return a lease's chips to the free pool."""
+        lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            raise LedgerError("release of unknown lease %r" % lease_id)
+        self._free += lease.chips
+        self._seq += 1
+        frame = {
+            "op": "lease_yield",
+            "seq": self._seq,
+            "lease": lease.lease_id,
+            "plane": lease.plane,
+            "chips": lease.chips,
+            "reason": reason,
+            "granted": self.granted,
+            "free": self._free,
+            "total": self._total,
+        }
+        self._events.append(frame)
+        self._check("release %s" % lease_id)
+        return lease
+
+    # -- invariants ---------------------------------------------------
+
+    def _check(self, where: str) -> None:
+        if self.granted + self._free != self._total:
+            raise LedgerError(
+                "conservation violated at %s: granted=%d free=%d total=%d"
+                % (where, self.granted, self._free, self._total)
+            )
+        if self._free < 0:
+            raise LedgerError("negative free pool at %s" % where)
+
+    def conserved(self) -> bool:
+        """True iff every recorded event satisfied conservation.
+
+        The live ``_check`` already raises on violation; this re-audits
+        the recorded frames so a consumer holding only the event log
+        (e.g. the MP soak parsing ``FABRIC_REPORT``) can re-verify.
+        """
+        for ev in self._events:
+            if ev["granted"] + ev["free"] != ev["total"]:
+                return False
+        return self.granted + self._free == self._total
+
+    def as_report(self) -> Dict[str, Any]:
+        return {
+            "total": self._total,
+            "free": self._free,
+            "granted": self.granted,
+            "held_train": self.held("train"),
+            "held_serve": self.held("serve"),
+            "leases": [l.as_dict() for l in self.leases()],
+            "events": self.events,
+            "conserved": self.conserved(),
+        }

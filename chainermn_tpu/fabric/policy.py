@@ -1,0 +1,153 @@
+"""Fabric rebalance policy: when to move chips between planes.
+
+The policy turns the same raw signals the autoscaler already consumes
+(``scale_signals`` watermarks, ``slo/burn_rate/*`` gauges, the
+``AnomalyDetector`` vote) into *fabric* actions — "take k trainer
+ranks for serving" or "give a drained replica's chips back to
+training" — debounced through the exact ``ScaleSignalFilter``
+hysteresis the autoscaler uses, so the two layers cannot disagree
+about what constitutes sustained pressure.
+
+Floors protect each plane from being starved by the other:
+``min_train_ranks`` bounds preemption, ``min_serve_replicas`` bounds
+drains.  Ceilings (``max_*``, 0 = uncapped) bound growth.  All
+decisions are pure functions of the inputs plus filter state — no
+wall-clock, no RNG (H005); callers inject ``now``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from chainermn_tpu.serving.cluster.health import ScaleSignalFilter
+
+
+@dataclass
+class FabricPolicyConfig:
+    """Knobs for the chip-rebalance policy.
+
+    ``chips_per_rank`` / ``chips_per_replica`` translate between plane
+    units and ledger chips (a TP-group replica spans several chips).
+    ``k_spike`` / ``k_trough`` / ``cooldown_s`` feed the shared
+    ``ScaleSignalFilter``: a spike vote must persist ``k_spike``
+    consecutive polls before chips move toward serving, a trough vote
+    (same drain candidate) ``k_trough`` polls before chips move back.
+    """
+
+    chips_per_rank: int = 1
+    chips_per_replica: int = 1
+    min_train_ranks: int = 1
+    min_serve_replicas: int = 1
+    ranks_per_move: int = 1
+    replicas_per_move: int = 1
+    k_spike: int = 3
+    k_trough: int = 5
+    cooldown_s: float = 2.0
+    burn_limit: float = 1.0
+    max_serve_replicas: int = 0  # 0 = uncapped
+    max_train_ranks: int = 0  # 0 = uncapped
+
+
+class FabricPolicy:
+    """Debounced two-plane rebalance decisions.
+
+    :meth:`decide` returns ``None`` (hold) or one action dict:
+
+    * ``{"action": "grant_free", "replicas": r, "chips": c}`` — serving
+      pressure and the free pool already covers the growth; no
+      preemption needed.
+    * ``{"action": "preempt_for_serving", "ranks": k, "chips": c}`` —
+      shrink training by ``k`` ranks and move their chips to serving.
+    * ``{"action": "return_to_training", "replica": rid, "ranks": k,
+      "chips": c}`` — drain replica ``rid`` and grow training.
+    """
+
+    def __init__(
+        self,
+        config: Optional[FabricPolicyConfig] = None,
+        clock=time.monotonic,
+    ):
+        self.config = config or FabricPolicyConfig()
+        c = self.config
+        self._filter = ScaleSignalFilter(
+            k_up=c.k_spike,
+            k_down=c.k_trough,
+            cooldown_s=c.cooldown_s,
+            clock=clock,
+        )
+
+    def decide(
+        self,
+        *,
+        signals: Dict[str, Any],
+        burn: float,
+        anomalous: bool,
+        train_ranks: int,
+        serve_replicas: int,
+        free_chips: int,
+        train_active: bool,
+        now: Optional[float] = None,
+    ) -> Optional[Dict[str, Any]]:
+        c = self.config
+        pressure = bool(
+            signals.get("scale_up") or burn >= c.burn_limit or anomalous
+        )
+        cand = signals.get("drain_candidate")
+        if cand is not None and not signals.get("scale_up"):
+            # The watermarks see a provably idle fleet (a drain
+            # candidate is only nominated with empty queues, an idle
+            # replica, and ample free pages).  Burn-rate gauges freeze
+            # at their last value once traffic stops, so a stale peak
+            # reading must not pin chips on serving through the trough:
+            # live idleness outranks a frozen burn.
+            pressure = False
+        vote = {
+            "scale_up": pressure,
+            "drain_candidate": cand,
+        }
+        decision = self._filter.update(vote, now=now)
+
+        if decision["scale_up"]:
+            if (
+                c.max_serve_replicas
+                and serve_replicas >= c.max_serve_replicas
+            ):
+                return None
+            r = c.replicas_per_move
+            need = r * c.chips_per_replica
+            if free_chips >= need:
+                return {"action": "grant_free", "replicas": r, "chips": need}
+            if not train_active:
+                return None
+            k = c.ranks_per_move
+            if train_ranks - k < c.min_train_ranks:
+                k = train_ranks - c.min_train_ranks
+            if k <= 0:
+                return None
+            return {
+                "action": "preempt_for_serving",
+                "ranks": k,
+                "chips": k * c.chips_per_rank,
+            }
+
+        cand = decision["drain"]
+        if cand is not None:
+            if serve_replicas - 1 < c.min_serve_replicas:
+                return None
+            if not train_active:
+                # Nothing to return chips to; let the autoscaler's own
+                # drain hysteresis handle pure-serving shrink instead.
+                return None
+            chips = c.chips_per_replica
+            k = max(1, chips // max(1, c.chips_per_rank))
+            if c.max_train_ranks and train_ranks + k > c.max_train_ranks:
+                return None
+            return {
+                "action": "return_to_training",
+                "replica": cand,
+                "ranks": k,
+                "chips": chips,
+            }
+        return None

@@ -107,6 +107,16 @@ class Autoscaler:
         #: second drain decision is refused until this one retires).
         self._draining = None
         self.events: List[dict] = []
+        #: arbiter-granted replica ceiling.  None (default) means
+        #: standalone operation: ``config.max_replicas`` caps growth as
+        #: before.  Once a fabric arbiter calls :meth:`set_capacity` /
+        #: :meth:`grant_capacity`, spawns are bounded by the granted
+        #: capacity instead — the fleet can no longer grow on its own;
+        #: it must be handed chips.
+        self.capacity: Optional[int] = None
+        #: callback invoked with the replica id after a retire
+        #: completes; the arbiter uses it to reclaim the lease.
+        self.on_retire: Optional[Callable[[object], None]] = None
 
     # -- inputs --------------------------------------------------------
     def _max_burn_rate(self) -> float:
@@ -141,6 +151,39 @@ class Autoscaler:
         rep = self.replica_factory(rid)
         self.router.add_replica(rep)
         return self._event("spawn", now, replica=rid, reason=reason)
+
+    # -- arbiter-granted capacity --------------------------------------
+    def set_capacity(self, n: int) -> None:
+        """Pin the replica ceiling to ``n`` (arbiter bootstrap).  From
+        here on the fleet grows only through :meth:`grant_capacity`."""
+        self.capacity = max(int(n), self.config.min_replicas)
+
+    def grant_capacity(self, n: int = 1, now: Optional[float] = None,
+                       reason: str = "backfill") -> List[object]:
+        """Raise the ceiling by ``n`` replicas and spawn them now
+        (arbiter hands over freshly freed chips).  Returns the new
+        replica ids so the caller can attach leases to them."""
+        now = self.clock() if now is None else now
+        base = self.capacity if self.capacity is not None \
+            else self._alive()
+        self.capacity = base + int(n)
+        rids = []
+        for _ in range(int(n)):
+            ev = self._spawn(now, reason=reason)
+            rids.append(ev["replica"])
+        return rids
+
+    def yield_capacity(self, n: int = 1) -> None:
+        """Lower the ceiling by ``n`` after capacity left the fleet
+        (retire completed, or a dead replica's lease was returned)."""
+        if self.capacity is not None:
+            self.capacity = max(
+                self.capacity - int(n), self.config.min_replicas,
+            )
+
+    def _ceiling(self) -> int:
+        return self.capacity if self.capacity is not None \
+            else self.config.max_replicas
 
     def force_drain(self, replica_id,
                     now: Optional[float] = None) -> bool:
@@ -193,6 +236,8 @@ class Autoscaler:
         if self.reporter is not None:
             self.reporter.gauge("autoscaler/replicas", alive)
             self.reporter.gauge("autoscaler/max_burn_rate", burn)
+            if self.capacity is not None:
+                self.reporter.gauge("autoscaler/capacity", self.capacity)
 
         # Emergency backfill: below the floor means replicas DIED (the
         # chaos path).  No hysteresis — failover already replayed the
@@ -210,12 +255,15 @@ class Autoscaler:
                 self.router.migrate_out(rid)
                 if self.router.retire_replica(rid):
                     self._draining = None
-                    return self._event("retire", now, replica=rid)
+                    ev = self._event("retire", now, replica=rid)
+                    if self.on_retire is not None:
+                        self.on_retire(rid)
+                    return ev
                 return None  # still emptying; hold other decisions
 
         decision = self._filter.update(signals, now=now)
         if decision["scale_up"]:
-            if alive >= c.max_replicas:
+            if alive >= self._ceiling():
                 return None
             if burn >= c.burn_limit:
                 reason = "burn_rate"

@@ -23,6 +23,7 @@ resumes bit-identically — the parity tests pin exactly that.
 from __future__ import annotations
 
 import dataclasses
+import math
 from collections import deque
 from enum import Enum
 from typing import Callable, Deque, Dict, List, Optional
@@ -184,6 +185,18 @@ class ContinuousBatchingScheduler:
         self.running: List[Request] = []
         self._finished: Dict[int, Request] = {}
         self._step = 0
+        # Deficit round-robin admission across tenants (off by default:
+        # empty weights keep the historical strict-FCFS order exactly).
+        # See set_tenant_weights.
+        self._tenant_weights: Dict[str, float] = {}
+        self._tenant_deficit: Dict[str, float] = {}
+        self._drr_ring: List[str] = []
+        self._drr_next = 0
+        self._pending_charge = None
+        #: the request a capacity-blocked admission stopped at (the
+        #: "head" under DRR order); run_to_completion's stuck-queue
+        #: diagnosis fails THIS request, not blindly waiting[0].
+        self._blocked_head: Optional[Request] = None
 
     # -- intake --------------------------------------------------------
     def add_request(self, req: Request) -> None:
@@ -237,13 +250,111 @@ class ContinuousBatchingScheduler:
         self.running.append(req)
 
     # -- policy helpers ------------------------------------------------
+    def set_tenant_weights(self, weights: Optional[Dict[str, float]]
+                           ) -> None:
+        """Turn on deficit-round-robin admission across tenants.
+
+        ``weights`` maps tenant id → share (e.g. from
+        ``TrafficSpec.tenant_weights()``); a tenant absent from the map
+        (including untenanted requests, keyed ``""``) gets weight 1.0.
+        With DRR on, one tenant's burst can no longer starve another:
+        each admission grants every backlogged tenant deficit credit in
+        proportion to its weight and serves the tenant whose head
+        affords its cost (prompt + max_new_tokens) first — admission
+        stays FCFS *within* a tenant, and capacity blocking stays
+        strict (a pick that doesn't fit stops admission; nobody skips
+        ahead of it).  Passing None/empty reverts to global FCFS."""
+        self._tenant_weights = dict(weights or {})
+        self._tenant_deficit = {}
+        self._drr_ring = []
+        self._drr_next = 0
+        self._pending_charge = None
+
+    def _tenant_of(self, req: Request) -> str:
+        return "" if req.tenant is None else str(req.tenant)
+
+    @staticmethod
+    def _admission_cost(req: Request) -> int:
+        return len(req.context) + req.max_new_tokens
+
+    def _next_admission(self) -> Request:
+        """The request DRR admits next (``waiting[0]`` when DRR is
+        off or only one tenant is backlogged).  Pure pick: the deficit
+        charge is staged in ``_pending_charge`` and applied by
+        :meth:`_charge_admission` only once the pick actually admits —
+        a capacity-blocked pick must not accumulate debt."""
+        self._pending_charge = None
+        if not self._tenant_weights:
+            return self.waiting[0]
+        heads: Dict[str, Request] = {}
+        for req in self.waiting:
+            t = self._tenant_of(req)
+            if t not in heads:
+                heads[t] = req
+        if len(heads) == 1:
+            return self.waiting[0]
+        # Deficits persist only while a tenant stays backlogged
+        # (standard DRR: going idle forfeits credit).
+        self._tenant_deficit = {
+            t: d for t, d in self._tenant_deficit.items() if t in heads
+        }
+        for t in sorted(heads):
+            if t not in self._drr_ring:
+                self._drr_ring.append(t)
+        self._drr_ring = [t for t in self._drr_ring if t in heads]
+        ring = self._drr_ring
+        quantum = max(
+            self._admission_cost(heads[t]) for t in heads
+        )
+        # How many credit rounds until each tenant's head is
+        # affordable; serve the soonest, ring order breaking ties.
+        best = None
+        for pos in range(len(ring)):
+            t = ring[(self._drr_next + pos) % len(ring)]
+            w = max(float(self._tenant_weights.get(t, 1.0)), 1e-9)
+            need = (self._admission_cost(heads[t])
+                    - self._tenant_deficit.get(t, 0.0))
+            rounds = max(0, math.ceil(need / (quantum * w)))
+            if best is None or rounds < best[0]:
+                best = (rounds, pos, t)
+        rounds, pos, pick = best
+        self._pending_charge = (pick, rounds, quantum,
+                                self._admission_cost(heads[pick]),
+                                sorted(heads))
+        return heads[pick]
+
+    def _charge_admission(self) -> None:
+        if self._pending_charge is None:
+            return
+        pick, rounds, quantum, cost, tenants = self._pending_charge
+        self._pending_charge = None
+        if rounds:
+            for t in tenants:
+                w = float(self._tenant_weights.get(t, 1.0))
+                self._tenant_deficit[t] = (
+                    self._tenant_deficit.get(t, 0.0)
+                    + rounds * quantum * w
+                )
+        self._tenant_deficit[pick] = (
+            self._tenant_deficit.get(pick, 0.0) - cost
+        )
+        if pick in self._drr_ring:
+            self._drr_next = (
+                (self._drr_ring.index(pick) + 1) % len(self._drr_ring)
+            )
+
     def _admit(self) -> List[Request]:
-        """FCFS admission until the batch or the cache (minus watermark)
-        is full.  Strict FCFS: stop at the first request that doesn't
-        fit — skipping ahead would starve large prompts."""
+        """Admission until the batch or the cache (minus watermark) is
+        full.  Default order is strict FCFS — stop at the first request
+        that doesn't fit; skipping ahead would starve large prompts.
+        With tenant weights set (:meth:`set_tenant_weights`) the *next*
+        request is chosen by deficit round-robin across backlogged
+        tenants instead, FCFS within each tenant; blocking stays
+        strict."""
         admitted = []
+        self._blocked_head = None
         while self.waiting and len(self.running) < self.engine.max_batch:
-            req = self.waiting[0]
+            req = self._next_admission()
             ctx = len(req.context)
             # Shared full pages covering the prompt's head are claimed
             # instead of allocated: a cache-hot prompt only pays for its
@@ -256,8 +367,13 @@ class ContinuousBatchingScheduler:
             reserve = self.watermark if self.running else 0
             if not self.engine.kv.can_allocate(ctx + 1, reserve=reserve,
                                                prefix_pages=prefix):
+                self._blocked_head = req
                 break
-            self.waiting.popleft()
+            if self.waiting[0] is req:
+                self.waiting.popleft()
+            else:
+                self.waiting.remove(req)
+            self._charge_admission()
             self.engine.kv.allocate(req.request_id, ctx,
                                     prefix_pages=prefix,
                                     tenant=req.tenant)
@@ -652,6 +768,15 @@ class ContinuousBatchingScheduler:
                                 len(self.running))
             self.reporter.gauge(f"serving/waiting{sfx}",
                                 len(self.waiting))
+            if self._tenant_weights:
+                # Deficit credit per backlogged tenant: positive means
+                # the tenant is owed service, negative that its last
+                # admission ran ahead of its share.
+                for ten in sorted(self._tenant_deficit):
+                    self.reporter.gauge(
+                        f"serve/tenant_deficit/{ten or 'default'}{sfx}",
+                        self._tenant_deficit[ten],
+                    )
             self.reporter.gauge(f"serving/cached_blocks{sfx}",
                                 st.cached_blocks)
             if self._prefix_lookup_tokens:
@@ -709,9 +834,13 @@ class ContinuousBatchingScheduler:
             made = self.step()
             if made == 0 and not self.running and self.waiting:
                 # waiting but nothing admittable and nothing running:
-                # the head request can never fit.
+                # the (DRR-ordered) head request can never fit.
+                victim = self._blocked_head
+                if victim is None or victim not in self.waiting:
+                    victim = self.waiting[0]
+                self.waiting.remove(victim)
                 self._fail(
-                    self.waiting.popleft(),
+                    victim,
                     "prompt cannot be admitted: exceeds cache capacity",
                 )
         return dict(self._finished)

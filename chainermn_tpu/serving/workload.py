@@ -116,11 +116,22 @@ class TrafficSpec:
     #: page-seconds) is built to attribute.
     tenants: int = 0
     tenant_zipf: float = 1.1
+    #: diurnal dimension (off by default — with ``diurnal=0`` the
+    #: arrival stream is byte-identical to pre-diurnal specs).  A
+    #: seeded day-curve envelope multiplies the MMPP intensity: one
+    #: fundamental over ``diurnal_period_s`` plus a second harmonic,
+    #: phases drawn from a CHILD generator, depth ``diurnal`` in
+    #: (0, 1).  Peaks trip the serving watermarks, troughs idle the
+    #: fleet — the signal the fabric arbiter trades chips on.
+    diurnal: float = 0.0
+    diurnal_period_s: float = 60.0
+    diurnal_phase: float = 0.0
 
     _INT = ("seed", "requests", "templates", "prefix_len", "vocab",
             "doc_templates", "tenants")
     _FLOAT = ("rate", "burst", "p_burst", "p_calm", "zipf_s",
-              "abusive_frac", "long_frac", "tenant_zipf")
+              "abusive_frac", "long_frac", "tenant_zipf",
+              "diurnal", "diurnal_period_s", "diurnal_phase")
 
     @classmethod
     def parse(cls, text: str) -> "TrafficSpec":
@@ -177,6 +188,42 @@ class TrafficSpec:
         x-axis of a goodput-vs-load curve): arrival rate scales, the
         arrival *pattern* (seed, templates, lengths) does not."""
         return dataclasses.replace(self, rate=self.rate * load_mult)
+
+    def diurnal_phases(self) -> Tuple[float, float]:
+        """Seeded phases (fundamental, second harmonic) of the day
+        curve — a child generator, so enabling the dimension never
+        perturbs the base arrival stream."""
+        drng = np.random.default_rng((self.seed, 0xD1E))
+        ph = drng.uniform(0.0, 1.0, size=2)
+        return (float(ph[0]), float(ph[1]))
+
+    def diurnal_envelope(self, t: float,
+                         phases: Optional[Tuple[float, float]] = None
+                         ) -> float:
+        """Intensity multiplier at ``t`` seconds into the trace
+        (identically 1.0 with the dimension off).  Clamped strictly
+        positive so troughs thin arrivals rather than stopping time."""
+        if self.diurnal <= 0:
+            return 1.0
+        if phases is None:
+            phases = self.diurnal_phases()
+        x = (t / max(self.diurnal_period_s, 1e-9)
+             + self.diurnal_phase)
+        wave = (0.75 * np.sin(2.0 * np.pi * (x + phases[0]))
+                + 0.25 * np.sin(4.0 * np.pi * (x + phases[1])))
+        return float(max(1.0 + self.diurnal * wave, 0.05))
+
+    def tenant_weights(self) -> dict:
+        """The Zipf tenant shares as an id→weight map (empty when the
+        tenant dimension is off) — the weights deficit-round-robin
+        admission (``scheduler.set_tenant_weights``) divides service
+        by."""
+        if self.tenants <= 0:
+            return {}
+        w = [1.0 / (k + 1) ** self.tenant_zipf
+             for k in range(self.tenants)]
+        s = sum(w)
+        return {f"t{k}": w[k] / s for k in range(self.tenants)}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -249,10 +296,16 @@ def generate(spec: TrafficSpec) -> List[Arrival]:
                              for k in range(spec.tenants)])
         tenant_w /= tenant_w.sum()
 
+    # Diurnal envelope phases, resolved once (child generator).
+    diurnal_on = spec.diurnal > 0
+    dphases = spec.diurnal_phases() if diurnal_on else None
+
     arrivals: List[Arrival] = []
     t, burst = 0.0, False
     for i in range(spec.requests):
         rate = spec.rate * (spec.burst if burst else 1.0)
+        if diurnal_on:
+            rate *= spec.diurnal_envelope(t, dphases)
         t += float(rng.exponential(1.0 / max(rate, 1e-9)))
         if burst:
             burst = rng.random() >= spec.p_calm

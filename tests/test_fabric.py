@@ -1,0 +1,449 @@
+"""Resource fabric: chip ledger, rebalance policy, arbiter lifecycle.
+
+The contract under test:
+
+1. **Conservation** — ``granted + free == total`` holds after every
+   ledger mutation, violations raise loudly, and the recorded event
+   frames re-audit (``conserved()``) so a consumer holding only the
+   ``FABRIC_REPORT`` log can re-verify.
+2. **Debounced policy** — chip moves need K *consecutive* votes
+   through the same ``ScaleSignalFilter`` hysteresis the autoscaler
+   uses; floors (``min_train_ranks``/``min_serve_replicas``) and
+   ceilings bound every decision; a stale burn-rate reading cannot pin
+   chips on serving through a provably idle trough.
+3. **Arbiter lifecycle** — against a REAL fleet (router + autoscaler +
+   engines) and a fake trainer handle: pressure → preempt → backfill,
+   trough → drain → regrow, with the ledger conserved at every step
+   and leases re-cut only after the plane reached its target shape.
+4. **Heartbeat wire compat** — fabric-stamped beats and legacy
+   bare-step beats decode through the same reader.
+
+All CPU, in-process.  The cross-process soak (real supervisor, real
+SIGKILL mid-arbitration, digest vs oracle) lives in
+tests/test_multiprocess.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from chainermn_tpu.elastic.heartbeat import (
+    BeatInfo,
+    FileBeat,
+    read_beat,
+    read_beat_info,
+)
+from chainermn_tpu.fabric import (
+    ChipLedger,
+    FabricArbiter,
+    FabricPolicy,
+    FabricPolicyConfig,
+    Lease,
+    LedgerError,
+)
+from chainermn_tpu.observability.reporter import Reporter
+from chainermn_tpu.serving import EngineConfig, InferenceEngine
+from chainermn_tpu.serving.cluster import (
+    Autoscaler,
+    AutoscalerConfig,
+    HeartbeatMonitor,
+    Replica,
+    ReplicaRouter,
+)
+
+VOCAB = 32
+
+
+# ---------------------------------------------------------------------------
+# ChipLedger: conservation
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_grant_release_conservation():
+    led = ChipLedger(4)
+    a = led.grant("train", 2, reason="bootstrap")
+    b = led.grant("serve", 1)
+    assert led.total == 4 and led.free == 1 and led.granted == 3
+    assert led.held("train") == 2 and led.held("serve") == 1
+    assert led.get(a.lease_id) == a
+    led.release(b.lease_id, reason="retire")
+    assert led.free == 2 and led.held("serve") == 0
+    assert led.conserved()
+    rep = led.as_report()
+    assert rep["conserved"] and rep["held_train"] == 2
+    assert [l["lease_id"] for l in rep["leases"]] == [a.lease_id]
+
+
+def test_ledger_rejects_overgrant_and_unknown_release():
+    led = ChipLedger(2)
+    led.grant("train", 2)
+    with pytest.raises(LedgerError):
+        led.grant("serve", 1)            # free pool empty
+    with pytest.raises(LedgerError):
+        led.grant("serve", 0)            # non-positive
+    with pytest.raises(LedgerError):
+        led.release("ls999")             # unknown lease
+    with pytest.raises(ValueError):
+        ChipLedger(0)
+    assert led.conserved()               # failed ops left no residue
+
+
+def test_ledger_event_frames_audit():
+    led = ChipLedger(3)
+    a = led.grant("train", 2)
+    led.release(a.lease_id)
+    ops = [e["op"] for e in led.events]
+    assert ops == ["lease_grant", "lease_yield"]
+    for ev in led.events:
+        assert ev["granted"] + ev["free"] == ev["total"] == 3
+    # seq is strictly increasing — replays are order-deterministic
+    seqs = [e["seq"] for e in led.events]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+
+def test_lease_wire_roundtrip_trailing_defaults():
+    lease = Lease(lease_id="ls1", plane="serve", chips=2,
+                  reason="backfill", granted_seq=7)
+    assert Lease.from_dict(lease.as_dict()) == lease
+    # an old frame missing the trailing fields still decodes
+    old = {"lease_id": "ls0", "plane": "train", "chips": 1}
+    got = Lease.from_dict(old)
+    assert got.reason == "" and got.granted_seq == 0
+
+
+# ---------------------------------------------------------------------------
+# FabricPolicy: hysteresis, floors, the stale-burn trough override
+# ---------------------------------------------------------------------------
+
+PRESSURE = {"scale_up": True, "drain_candidate": None}
+HOLD = {"scale_up": False, "drain_candidate": None}
+
+
+def mk_policy(**over):
+    cfg = dict(k_spike=2, k_trough=2, cooldown_s=0.0,
+               min_train_ranks=1, min_serve_replicas=1)
+    cfg.update(over)
+    return FabricPolicy(FabricPolicyConfig(**cfg))
+
+
+def decide(pol, signals, now, *, burn=0.0, anomalous=False,
+           train_ranks=2, serve_replicas=2, free_chips=0,
+           train_active=True):
+    return pol.decide(signals=signals, burn=burn, anomalous=anomalous,
+                      train_ranks=train_ranks,
+                      serve_replicas=serve_replicas,
+                      free_chips=free_chips, train_active=train_active,
+                      now=now)
+
+
+def test_policy_spike_needs_consecutive_votes():
+    pol = mk_policy(k_spike=3)
+    assert decide(pol, PRESSURE, 0.0) is None
+    assert decide(pol, HOLD, 0.1) is None       # streak broken
+    assert decide(pol, PRESSURE, 0.2) is None
+    assert decide(pol, PRESSURE, 0.3) is None
+    act = decide(pol, PRESSURE, 0.4)
+    assert act == {"action": "preempt_for_serving", "ranks": 1,
+                   "chips": 1}
+
+
+def test_policy_grant_free_before_preempting():
+    pol = mk_policy()
+    decide(pol, PRESSURE, 0.0, free_chips=1)
+    act = decide(pol, PRESSURE, 0.1, free_chips=1)
+    assert act == {"action": "grant_free", "replicas": 1, "chips": 1}
+
+
+def test_policy_preempt_respects_train_floor():
+    pol = mk_policy(min_train_ranks=2, ranks_per_move=2)
+    decide(pol, PRESSURE, 0.0, train_ranks=3)
+    # only 1 rank above the floor: the move is clamped to it
+    act = decide(pol, PRESSURE, 0.1, train_ranks=3)
+    assert act["action"] == "preempt_for_serving" and act["ranks"] == 1
+    # at the floor (and past cooldown) pressure yields nothing
+    pol2 = mk_policy(min_train_ranks=2)
+    decide(pol2, PRESSURE, 0.0, train_ranks=2)
+    assert decide(pol2, PRESSURE, 0.1, train_ranks=2) is None
+
+
+def test_policy_trough_floors_and_ceiling():
+    idle = {"scale_up": False, "drain_candidate": "s1"}
+    pol = mk_policy()
+    decide(pol, idle, 0.0)
+    act = decide(pol, idle, 0.1)
+    assert act == {"action": "return_to_training", "replica": "s1",
+                   "ranks": 1, "chips": 1}
+    # min_serve_replicas floor
+    pol = mk_policy(min_serve_replicas=2)
+    decide(pol, idle, 0.0, serve_replicas=2)
+    assert decide(pol, idle, 0.1, serve_replicas=2) is None
+    # max_train_ranks ceiling: training already at launch size
+    pol = mk_policy(max_train_ranks=2)
+    decide(pol, idle, 0.0, train_ranks=2)
+    assert decide(pol, idle, 0.1, train_ranks=2) is None
+    # nothing to return chips to once training finished
+    pol = mk_policy()
+    decide(pol, idle, 0.0, train_active=False)
+    assert decide(pol, idle, 0.1, train_active=False) is None
+
+
+def test_policy_stale_burn_does_not_block_trough():
+    """Burn gauges freeze at their last value when traffic stops; a
+    drain candidate nominated by live watermarks must still win."""
+    idle = {"scale_up": False, "drain_candidate": "s0"}
+    pol = mk_policy()
+    decide(pol, idle, 0.0, burn=25.0)
+    act = decide(pol, idle, 0.1, burn=25.0)
+    assert act is not None
+    assert act["action"] == "return_to_training"
+    # ...but live pressure (scale_up watermark) still outranks the
+    # candidate: no drain while queues are hot.
+    hot = {"scale_up": True, "drain_candidate": "s0"}
+    pol = mk_policy()
+    decide(pol, hot, 0.0)
+    act = decide(pol, hot, 0.1)
+    assert act["action"] == "preempt_for_serving"
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat wire compat
+# ---------------------------------------------------------------------------
+
+
+def test_beat_fabric_payload_roundtrip(tmp_path):
+    path = str(tmp_path / "hb.rank0")
+    fb = FileBeat(path, plane="train", lease_id="ls3", world=2)
+    fb.beat(41)
+    info = read_beat_info(path)
+    assert info == BeatInfo(mtime=info.mtime, step=41, plane="train",
+                            lease_id="ls3", world=2)
+    # an old supervisor only ever stats the mtime
+    assert read_beat(path) == info.mtime
+
+
+def test_beat_legacy_formats_still_decode(tmp_path):
+    path = str(tmp_path / "hb.rank1")
+    FileBeat(path).beat(7)              # legacy bare-step writer
+    info = read_beat_info(path)
+    assert info.step == 7 and info.plane == "" and info.world == 0
+    FileBeat(path).beat(None)           # legacy empty beat
+    info = read_beat_info(path)
+    assert info.step == -1
+    assert read_beat_info(str(tmp_path / "missing")) is None
+
+
+# ---------------------------------------------------------------------------
+# Arbiter lifecycle against a real fleet + a fake trainer handle
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    return TransformerLM(vocab=VOCAB, d_model=16, n_heads=2, d_ff=32,
+                         n_layers=2, max_len=64)
+
+
+@pytest.fixture(scope="module")
+def lm_params(lm):
+    return lm.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def make_engine(lm, lm_params, **over):
+    cfg = dict(block_size=4, n_blocks=64, max_len=64, max_batch=4)
+    cfg.update(over)
+    return InferenceEngine(lm, lm_params, EngineConfig(**cfg))
+
+
+class FakeTrainer:
+    """Duck-typed trainer plane with the supervisor's asynchrony: a
+    yield/grant only changes ``world`` when the test calls
+    :meth:`settle` — modeling the checkpoint → exit 75 → respawn
+    round-trip the arbiter must wait out."""
+
+    def __init__(self, world=2):
+        self.world = world
+        self.active = True
+        self._pending = None
+
+    def yield_ranks(self, k):
+        self._pending = self.world - k
+        return True
+
+    def grant_ranks(self, k):
+        self._pending = self.world + k
+        return True
+
+    def settle(self):
+        if self._pending is not None:
+            self.world = self._pending
+            self._pending = None
+
+
+def mk_fabric(lm, lm_params, *, n=2, world=2, max_queue=4, total=None):
+    reporter = Reporter()
+    reps = [
+        Replica(f"s{i}", make_engine(lm, lm_params), role="both",
+                reporter=reporter, max_queue=max_queue)
+        for i in range(n)
+    ]
+    router = ReplicaRouter(
+        reps, reporter=reporter,
+        health=HeartbeatMonitor([r.replica_id for r in reps],
+                                miss_after_s=30.0),
+    )
+
+    def factory(rid):
+        return Replica(rid, make_engine(lm, lm_params), role="both",
+                       reporter=reporter, max_queue=max_queue)
+
+    # The arbiter owns rebalancing: freeze the autoscaler's own
+    # hysteresis so only the capacity/backfill surfaces act.
+    scaler = Autoscaler(
+        router, factory,
+        AutoscalerConfig(min_replicas=1, max_replicas=n, k_up=10 ** 6,
+                         k_down=10 ** 6, cooldown_s=0.0),
+        reporter=reporter,
+    )
+    trainer = FakeTrainer(world=world)
+    ledger = ChipLedger(total if total is not None else world + n)
+    arb = FabricArbiter(
+        ledger, trainer, scaler,
+        policy=FabricPolicy(FabricPolicyConfig(
+            k_spike=2, k_trough=2, cooldown_s=0.0,
+            min_train_ranks=1, min_serve_replicas=1,
+            max_train_ranks=world,
+        )),
+        reporter=reporter,
+    )
+    return reporter, router, scaler, trainer, ledger, arb
+
+
+def test_arbiter_full_round_trip_conserves_chips(lm, lm_params):
+    reporter, router, scaler, trainer, led, arb = mk_fabric(
+        lm, lm_params)
+    arb.bootstrap()
+    assert led.held("train") == 2 and led.held("serve") == 2
+    assert led.free == 0 and scaler.capacity == 2
+
+    # Peak: fill both queues past the pressure watermark.
+    handles = [router.submit([1 + i % 8, 2], 4) for i in range(8)]
+    assert arb.step(now=0.0) is None              # streak == 1
+    ev = arb.step(now=0.1)
+    assert ev["action"] == "preempt_start" and ev["target_world"] == 1
+    assert arb.step(now=0.2) is None              # respawn not settled
+    assert led.held("train") == 2                 # chips stay put until then
+    trainer.settle()
+    ev = arb.step(now=0.3)
+    assert ev["action"] == "preempt_for_serving_done"
+    assert ev["backfill"] == ["as0"] and "as0" in router.replicas
+    assert led.held("train") == 1 and led.held("serve") == 3
+    assert led.free == 0 and scaler.capacity == 3
+    assert arb.transitions["preempt_for_serving"] == 1
+
+    router.run_until_idle()
+    assert all(h.status == "finished" for h in handles)
+
+    # Trough: idle fleet nominates a drain candidate; pump the scaler
+    # (it progresses migrate → retire) alongside the arbiter.
+    now, actions = 1.0, []
+    for _ in range(20):
+        scaler.step(now=now)
+        ev = arb.step(now=now)
+        if ev is not None:
+            actions.append(ev["action"])
+        if actions and actions[-1] == "regrow_start":
+            trainer.settle()
+        if actions and actions[-1] == "return_to_training_done":
+            break
+        now += 0.1
+    assert actions[-1] == "return_to_training_done"
+    assert "drain_start" in actions and "regrow_start" in actions
+    assert trainer.world == 2
+    assert led.held("train") == 2 and led.held("serve") == 2
+    assert led.free == 0 and led.conserved()
+    assert arb.transitions["return_to_training"] == 1
+    assert scaler.capacity == 2
+    # fabric gauges rode the reporter (published at the top of step,
+    # so one more step snapshots the settled state)
+    arb.step(now=now + 1.0)
+    gauges = reporter.summary()["gauges"]
+    assert gauges["fabric/train_chips"]["value"] == 2
+    assert gauges["fabric/serve_chips"]["value"] == 2
+
+
+def test_arbiter_reclaims_dead_replica_lease(lm, lm_params):
+    reporter, router, scaler, trainer, led, arb = mk_fabric(
+        lm, lm_params)
+    arb.bootstrap()
+    router.fail_replica("s1", reason="test kill")
+    arb.step(now=0.0)
+    assert [e["action"] for e in arb.events][-1] == "lease_reclaim"
+    assert led.held("serve") == 1 and led.free == 1
+    assert led.conserved() and scaler.capacity == 1
+
+
+def test_arbiter_transfers_lease_to_backfill_twin(lm, lm_params):
+    reporter, router, scaler, trainer, led, arb = mk_fabric(
+        lm, lm_params)
+    arb.bootstrap()
+    # an unleased alive replica (the emergency-backfill shape)
+    router.add_replica(
+        Replica("bf", make_engine(lm, lm_params), role="both",
+                reporter=reporter, max_queue=4))
+    router.fail_replica("s0", reason="test kill")
+    arb.step(now=0.0)
+    ev = arb.events[-1]
+    assert ev["action"] == "lease_transfer"
+    assert ev["dead"] == "s0" and ev["to"] == "bf"
+    assert led.held("serve") == 2 and led.free == 0  # custody moved
+    assert led.conserved()
+
+
+def test_arbiter_releases_train_lease_when_training_finishes(
+        lm, lm_params):
+    reporter, router, scaler, trainer, led, arb = mk_fabric(
+        lm, lm_params)
+    arb.bootstrap()
+    trainer.active = False
+    arb.step(now=0.0)
+    assert "train_done" in [e["action"] for e in arb.events]
+    assert led.held("train") == 0 and led.free == 2
+    assert led.conserved()
+
+
+# ---------------------------------------------------------------------------
+# Supervisor control surface + CLI smoke
+# ---------------------------------------------------------------------------
+
+
+def test_supervisor_resize_refused_when_not_running():
+    from chainermn_tpu.elastic.supervisor import (
+        ElasticSupervisor,
+        SupervisorConfig,
+    )
+
+    sup = ElasticSupervisor(SupervisorConfig(
+        argv=[sys.executable, "-c", "pass"], nproc=2))
+    assert not sup.yield_ranks(1)
+    assert not sup.grant_ranks(1)
+    sup.set_lease_tag("ls1")
+    assert sup.lease_tag == "ls1"
+    assert sup.lease_rescales == 0
+
+
+def test_fabric_cli_help_smoke():
+    out = subprocess.run(
+        [sys.executable, "-m", "chainermn_tpu.tools.fabric", "--help"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0
+    assert "--no-arbiter" in out.stdout
+    assert "--kill-rank-on-transfer" in out.stdout

@@ -102,7 +102,9 @@ def test_wire_lockfile_is_current():
     )
     # the load-bearing structs are actually locked
     for key in ("dataclass:ReplicaLoad", "dataclass:KVSnapshot",
-                "cmd:submit", "frame:tok", "meta:kv_snapshot"):
+                "cmd:submit", "frame:tok", "meta:kv_snapshot",
+                "dataclass:Lease", "dataclass:BeatInfo",
+                "cmd:lease_grant", "cmd:lease_yield"):
         assert key in lock["schemas"], key
 
 

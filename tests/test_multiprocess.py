@@ -630,3 +630,113 @@ def test_elastic_supervisor_preemption_soak(tmp_path, elastic_oracle):
     assert report["params_digest"] == elastic_oracle["digest"], (
         report, elastic_oracle["digest"],
     )
+
+
+# ---------------------------------------------------------------------------
+# Resource fabric: diurnal soak, chips traded between the planes
+# ---------------------------------------------------------------------------
+_FABRIC_TRAFFIC = ("requests=60,rate=30,burst=3,diurnal=0.6,"
+                   "diurnal_period_s=6,tenants=2,vocab=24")
+
+
+def _run_fabric(workdir, *extra, timeout=540):
+    """One fabric run: elastic 2-rank trainer + 2-replica fleet + the
+    chip arbiter, all under ``tools.fabric``.  Returns (proc, stdout,
+    parsed FABRIC_REPORT)."""
+    import json
+
+    env = subprocess_env(n_devices=1)
+    cmd = [
+        sys.executable, "-m", "chainermn_tpu.tools.fabric",
+        "--nproc", "2", "--replicas", "2", "--train-steps", "160",
+        "--hb-timeout", "30", "--deadline-s", "90",
+        "--traffic", _FABRIC_TRAFFIC,
+        "--workdir", str(workdir), *extra,
+    ]
+    try:
+        p = subprocess.run(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired as e:
+        pytest.fail(f"fabric driver timed out:\n{e.stdout}")
+    reports = [
+        ln for ln in p.stdout.splitlines()
+        if ln.startswith("FABRIC_REPORT ")
+    ]
+    assert reports, p.stdout
+    return p, p.stdout, json.loads(reports[-1].split(" ", 1)[1])
+
+
+@pytest.fixture(scope="module")
+def fabric_oracle(tmp_path_factory):
+    """The no-arbiter arm: same diurnal workload, training at a flat
+    2 ranks, fleet pinned at 2 replicas — digest + stream baseline."""
+    base = tmp_path_factory.mktemp("fabric_oracle")
+    p, out, report = _run_fabric(base / "work", "--no-arbiter")
+    assert p.returncode == 0, out
+    assert report["train"]["status"] == "ok", report["train"]
+    assert report["train"]["params_digest"], report["train"]
+    assert report["dropped_streams"] == 0, report
+    assert report["parity"]["mismatches"] == [], report["parity"]
+    return report
+
+
+def test_fabric_diurnal_round_trip_soak(tmp_path, fabric_oracle):
+    """The tentpole soak: under the diurnal day-curve the arbiter must
+    complete a full chip round trip — preempt trainer ranks at the peak
+    (grace checkpoint → exit 75 → respawn at N−k, backfill replica from
+    the freed chips), return them at the trough (drain → migrate →
+    retire → regrow) — while FOUR invariants hold at once:
+
+    * training's final params digest is BIT-IDENTICAL to the
+      uninterrupted no-arbiter oracle (the int64 gradient wire makes
+      the digest world-size-invariant, so this pins exact resume);
+    * zero dropped streams, every checked stream oracle-exact;
+    * the chip ledger conserves ``granted + free == total`` across
+      every recorded event;
+    * the rescale waves ride the lease path (lease_rescales, not the
+      crash-restart or preemption budgets).
+    """
+    p, out, report = _run_fabric(tmp_path / "work")
+    assert p.returncode == 0, out
+    tr = report["transitions"]
+    assert tr["preempt_for_serving"] >= 1, report
+    assert tr["return_to_training"] >= 1, report
+    train = report["train"]
+    assert train["status"] == "ok", train
+    assert train["lease_rescales"] >= 2, train
+    assert train["restarts"] == 0, train
+    assert train["params_digest"] == \
+        fabric_oracle["train"]["params_digest"], (
+            train, fabric_oracle["train"])
+    assert report["dropped_streams"] == 0, report
+    assert report["parity"]["checked"] > 0, report["parity"]
+    assert report["parity"]["mismatches"] == [], report["parity"]
+    assert report["ledger_conserved"], report["ledger"]
+    led = report["ledger"]
+    assert led["granted"] + led["free"] == led["total"], led
+    for ev in led["events"]:
+        assert ev["granted"] + ev["free"] == ev["total"], ev
+    assert all(b < 1.0 for b in report["burn_rates"].values()), report
+
+
+def test_fabric_chaos_kill_mid_arbitration_soak(tmp_path,
+                                                fabric_oracle):
+    """SIGKILL a trainer rank while a chip transfer is in flight: the
+    supervisor's crash path resumes from the newest consistent
+    checkpoint generation, the arbiter's ledger stays conserved, and
+    the digest still lands bit-identical to the oracle."""
+    p, out, report = _run_fabric(
+        tmp_path / "work", "--kill-rank-on-transfer", "1",
+    )
+    assert p.returncode == 0, out
+    assert report["chaos_kill_fired"], report
+    train = report["train"]
+    assert train["status"] == "ok", train
+    assert train["params_digest"] == \
+        fabric_oracle["train"]["params_digest"], (
+            train, fabric_oracle["train"])
+    assert report["dropped_streams"] == 0, report
+    assert report["parity"]["mismatches"] == [], report["parity"]
+    assert report["ledger_conserved"], report["ledger"]

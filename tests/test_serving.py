@@ -432,6 +432,69 @@ def test_scheduler_rejects_impossible_requests(lm, lm_params):
     assert engine.kv.used_blocks == 0
 
 
+def test_scheduler_tenant_drr_interleaves_backlogged_tenants(
+        lm, lm_params):
+    """A tenant that floods the queue first no longer monopolizes
+    admission: with equal weights, two backlogged tenants alternate
+    (FIFO preserved *within* each tenant), and clearing the weights
+    reverts to the historical global FCFS exactly."""
+    def run(weights):
+        engine = make_engine(lm, lm_params, max_batch=1)
+        sched = ContinuousBatchingScheduler(engine)
+        sched.set_tenant_weights(weights)
+        order = []
+        for i in range(8):
+            req = Request(request_id=i, prompt=[1 + i % 8, 2, 3],
+                          max_new_tokens=4,
+                          tenant="a" if i < 4 else "b")
+            req.on_token = (
+                lambda rid, tok: order.append(rid) if rid not in order
+                else None
+            )
+            sched.add_request(req)
+        sched.run_to_completion()
+        return order
+
+    # all of tenant a submitted before any of tenant b, equal costs
+    assert run({"a": 1.0, "b": 1.0}) == [0, 4, 1, 5, 2, 6, 3, 7]
+    assert run(None) == list(range(8))        # off-switch: strict FCFS
+
+
+def test_scheduler_tenant_drr_weighted_shares_and_gauges(
+        lm, lm_params):
+    """Weights divide admission service: at 2:1 and equal costs, the
+    first 9 serialized admissions split exactly 6/3, and the deficit
+    counters ride the Reporter as serve/tenant_deficit/<id> gauges."""
+    from chainermn_tpu.observability import Reporter
+
+    rep = Reporter()
+    engine = make_engine(lm, lm_params, max_batch=1)
+    sched = ContinuousBatchingScheduler(engine, reporter=rep)
+    sched.set_tenant_weights({"a": 2.0, "b": 1.0})
+    order = []
+    for i in range(24):
+        req = Request(request_id=i, prompt=[1 + i % 8, 2, 3],
+                      max_new_tokens=4,
+                      tenant="a" if i % 2 == 0 else "b")
+        req.on_token = (
+            lambda rid, tok: order.append(rid) if rid not in order
+            else None
+        )
+        sched.add_request(req)
+    sched.run_to_completion()
+    first9 = order[:9]
+    by_tenant = {"a": 0, "b": 0}
+    for rid in first9:
+        by_tenant["a" if rid % 2 == 0 else "b"] += 1
+    assert by_tenant == {"a": 6, "b": 3}
+    # FIFO within each tenant throughout
+    for parity in (0, 1):
+        got = [rid for rid in order if rid % 2 == parity]
+        assert got == sorted(got)
+    gauges = rep.summary()["gauges"]
+    assert any(k.startswith("serve/tenant_deficit/") for k in gauges)
+
+
 def test_scheduler_publishes_gauges_and_counters(lm, lm_params):
     from chainermn_tpu.observability import Reporter
 
